@@ -1,0 +1,119 @@
+"""Tests for thinvids_tpu.core: status, config layering, events, types."""
+
+import numpy as np
+import pytest
+
+from thinvids_tpu.core import (
+    ActivityLog,
+    DEFAULT_SETTINGS,
+    Frame,
+    GopSpec,
+    EncodedSegment,
+    Status,
+    get_settings,
+)
+from thinvids_tpu.core.config import (
+    as_bool,
+    as_int,
+    invalidate_settings_cache,
+    update_live_settings,
+)
+from thinvids_tpu.core.types import concat_segments, pad_to_multiple
+
+
+class TestStatus:
+    def test_parse_lenient(self):
+        assert Status.parse("RUNNING") is Status.RUNNING
+        assert Status.parse("  done \n") is Status.DONE
+        assert Status.parse("garbage") is Status.READY
+        assert Status.parse(None) is Status.READY
+        assert Status.parse(Status.FAILED) is Status.FAILED
+
+    def test_active_terminal(self):
+        assert Status.RUNNING.is_active
+        assert Status.STARTING.is_active
+        assert not Status.WAITING.is_active
+        assert Status.DONE.is_terminal
+        assert not Status.RUNNING.is_terminal
+
+
+class TestConfig:
+    def setup_method(self):
+        invalidate_settings_cache()
+
+    def teardown_method(self):
+        invalidate_settings_cache()
+
+    def test_defaults(self):
+        s = get_settings(refresh=True)
+        assert s.qp == DEFAULT_SETTINGS["qp"]
+        assert s.gop_frames == 32
+
+    def test_live_override_and_clamp(self):
+        update_live_settings({"qp": "99", "gop_frames": 16, "bogus_key": 1})
+        s = get_settings(refresh=True)
+        assert s.qp == 51  # clamped
+        assert s.gop_frames == 16
+        assert "bogus_key" not in s.values
+
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("TVT_QP", "33")
+        s = get_settings(refresh=True)
+        assert s.qp == 33
+
+    def test_effective_max_active_jobs(self):
+        update_live_settings({"pipeline_worker_count": 10, "max_active_jobs": 0})
+        s = get_settings(refresh=True)
+        assert s.effective_max_active_jobs() == 5
+        update_live_settings({"max_active_jobs": 3})
+        assert get_settings(refresh=True).effective_max_active_jobs() == 3
+
+    def test_coercions(self):
+        assert as_bool("yes") and as_bool("1") and not as_bool("off")
+        assert as_int("12.7") == 12
+        assert as_int("junk", 5) == 5
+
+
+class TestActivityLog:
+    def test_emit_fetch_labels(self):
+        log = ActivityLog(cap=4)
+        log.emit("encode_part", "part finished", job_id="j1", part=3, elapsed_ms=120)
+        log.emit("job_failed", "boom", job_id="j1")
+        events = log.fetch()
+        assert events[0]["label"] == "ERROR"
+        assert events[1]["label"] == "ENCODE"
+        lines = log.fetch_job("j1")
+        assert len(lines) == 2
+        assert "part=3" in lines[0]
+
+    def test_cap(self):
+        log = ActivityLog(cap=2)
+        for i in range(5):
+            log.emit("start", f"e{i}")
+        assert len(log.fetch()) == 2
+
+
+class TestTypes:
+    def test_pad_to_multiple(self):
+        p = np.arange(20, dtype=np.uint8).reshape(4, 5)
+        out = pad_to_multiple(p, 16)
+        assert out.shape == (16, 16)
+        assert (out[:4, :5] == p).all()
+        assert out[3, 10] == p[3, 4]  # edge replication
+
+    def test_frame_padded_chroma(self):
+        y = np.zeros((30, 50), np.uint8)
+        u = np.zeros((15, 25), np.uint8)
+        v = np.zeros((15, 25), np.uint8)
+        f = Frame(y, u, v).padded(16)
+        assert f.y.shape == (32, 64)
+        assert f.u.shape == (16, 32)
+
+    def test_concat_order_and_missing(self):
+        segs = [
+            EncodedSegment(GopSpec(1, 32, 32), b"b"),
+            EncodedSegment(GopSpec(0, 0, 32), b"a"),
+        ]
+        assert concat_segments(segs) == b"ab"
+        with pytest.raises(ValueError):
+            concat_segments([EncodedSegment(GopSpec(1, 32, 32), b"b")])

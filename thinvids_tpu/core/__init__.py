@@ -1,0 +1,29 @@
+from .status import Status
+from .types import (
+    ChromaFormat,
+    FrameType,
+    VideoMeta,
+    Frame,
+    GopSpec,
+    SegmentPlan,
+    EncodedSegment,
+)
+from .config import Settings, get_settings, DEFAULT_SETTINGS
+from .events import ActivityLog
+from .log import get_logging
+
+__all__ = [
+    "Status",
+    "ChromaFormat",
+    "FrameType",
+    "VideoMeta",
+    "Frame",
+    "GopSpec",
+    "SegmentPlan",
+    "EncodedSegment",
+    "Settings",
+    "get_settings",
+    "DEFAULT_SETTINGS",
+    "ActivityLog",
+    "get_logging",
+]

@@ -1,0 +1,203 @@
+"""Layered configuration system.
+
+Port of the reference's four-tier precedence (SURVEY.md §5.6; reference
+/root/reference/common.py:168-229, manager/app.py:1750-1916):
+
+    code defaults  <  environment  <  live (runtime-tunable)  <  per-job
+
+The live tier is an in-process dict guarded by a lock with a TTL read cache
+(the reference used a Redis hash with a 10 s cache); the cluster API mutates
+it via ``update_live_settings`` with the same validation/clamping the
+reference applied in its POST /settings handler.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Any, Callable, Mapping
+
+# Defaults mirror the reference's DEFAULT_SETTINGS knobs where the concept
+# survives the TPU redesign (/root/reference/common.py:173-191), plus
+# TPU-native knobs (qp, gop size, device axis names).
+DEFAULT_SETTINGS: dict[str, Any] = {
+    # admission / scheduling
+    "auto_start_jobs": True,
+    "max_active_jobs": 0,            # 0 = derived: pipeline_workers // 2
+    "pipeline_worker_count": 8,      # logical pipeline slots (devices or hosts)
+    "drain_ratio": 0.75,             # admit next job at >= this encode drain
+    "min_idle_workers": 4,
+    "reject_av1": False,             # we ENCODE AV1 (ref rejected it as input)
+    "large_file_gb": 15.0,
+    "large_file_behavior": "direct",  # reject | direct | nfs
+    # segmentation / sharding
+    "gop_frames": 32,                # closed-GOP length (frames)
+    "target_segment_frames": 0,      # 0 = one GOP per shard
+    "max_segments": 200,
+    # encoder operating point (analog of VEM_* env knobs)
+    "rc_mode": "cqp",                # cqp | vbr2pass
+    "qp": 27,
+    "target_height": 1080,
+    "software_fallback": True,       # pure-JAX CPU path when no TPU
+    # liveness / watchdog budgets (seconds)
+    "metrics_ttl_s": 15.0,
+    "active_window_s": 5.0,
+    "scheduler_poll_s": 2.0,
+    "watchdog_poll_s": 15.0,
+    "stall_starting_s": 300.0,
+    "stall_running_s": 900.0,
+    "stall_stamping_s": 900.0,
+    "heartbeat_throttle_s": 15.0,
+    "part_failure_max_retries": 5,
+    # idle suspend (agent)
+    "suspend_enabled": False,
+    "suspend_idle_s": 300.0,
+    "suspend_cpu_pct": 20.0,
+}
+
+_ENV_PREFIX = "TVT_"
+
+_BOOL_TRUE = {"1", "true", "yes", "on"}
+_BOOL_FALSE = {"0", "false", "no", "off"}
+
+
+def as_bool(value: Any, default: bool = False) -> bool:
+    if isinstance(value, bool):
+        return value
+    if value is None:
+        return default
+    text = str(value).strip().lower()
+    if text in _BOOL_TRUE:
+        return True
+    if text in _BOOL_FALSE:
+        return False
+    return default
+
+
+def as_int(value: Any, default: int = 0) -> int:
+    try:
+        return int(float(str(value).strip()))
+    except (TypeError, ValueError):
+        return default
+
+
+def as_float(value: Any, default: float = 0.0) -> float:
+    try:
+        return float(str(value).strip())
+    except (TypeError, ValueError):
+        return default
+
+
+def _coerce_like(default: Any, raw: Any) -> Any:
+    if isinstance(default, bool):
+        return as_bool(raw, default)
+    if isinstance(default, int):
+        return as_int(raw, default)
+    if isinstance(default, float):
+        return as_float(raw, default)
+    return str(raw)
+
+
+# Validation clamps applied on live updates, mirroring the reference's
+# POST /settings clamping (/root/reference/manager/app.py:1790-1916).
+_CLAMPS: dict[str, Callable[[Any], Any]] = {
+    "qp": lambda v: min(51, max(0, as_int(v, 27))),
+    "gop_frames": lambda v: min(600, max(1, as_int(v, 32))),
+    "max_segments": lambda v: min(4096, max(1, as_int(v, 200))),
+    "drain_ratio": lambda v: min(1.0, max(0.0, as_float(v, 0.75))),
+    "pipeline_worker_count": lambda v: min(4096, max(1, as_int(v, 8))),
+    "min_idle_workers": lambda v: max(0, as_int(v, 4)),
+    "target_height": lambda v: as_int(v, 1080)
+    if as_int(v, 1080) in (480, 576, 720, 1080, 2160)
+    else 1080,
+    "rc_mode": lambda v: str(v) if str(v) in ("cqp", "vbr2pass") else "cqp",
+    "large_file_behavior": lambda v: str(v)
+    if str(v) in ("reject", "direct", "nfs")
+    else "direct",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Settings:
+    """Immutable snapshot of merged settings at read time."""
+
+    values: Mapping[str, Any]
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self.values[name]
+        except KeyError as exc:  # pragma: no cover - programming error
+            raise AttributeError(name) from exc
+
+    def get(self, name: str, default: Any = None) -> Any:
+        return self.values.get(name, default)
+
+    def effective_max_active_jobs(self) -> int:
+        explicit = as_int(self.values.get("max_active_jobs"), 0)
+        if explicit > 0:
+            return explicit
+        return max(1, as_int(self.values.get("pipeline_worker_count"), 8) // 2)
+
+
+class _LiveStore:
+    """Runtime-tunable settings tier with a short TTL read cache."""
+
+    def __init__(self, ttl_s: float = 10.0) -> None:
+        self._lock = threading.Lock()
+        self._live: dict[str, Any] = {}
+        self._ttl_s = ttl_s
+        self._cached: Settings | None = None
+        self._cached_at = 0.0
+
+    def snapshot(self) -> Settings:
+        now = time.monotonic()
+        with self._lock:
+            if self._cached is not None and now - self._cached_at < self._ttl_s:
+                return self._cached
+            merged = dict(DEFAULT_SETTINGS)
+            for key, default in DEFAULT_SETTINGS.items():
+                env = os.environ.get(_ENV_PREFIX + key.upper())
+                if env is not None:
+                    merged[key] = _coerce_like(default, env)
+            merged.update(self._live)
+            snap = Settings(values=merged)
+            self._cached = snap
+            self._cached_at = now
+            return snap
+
+    def update(self, updates: Mapping[str, Any]) -> dict[str, Any]:
+        applied: dict[str, Any] = {}
+        with self._lock:
+            for key, raw in updates.items():
+                if key not in DEFAULT_SETTINGS:
+                    continue
+                clamp = _CLAMPS.get(key)
+                value = clamp(raw) if clamp else _coerce_like(DEFAULT_SETTINGS[key], raw)
+                self._live[key] = value
+                applied[key] = value
+            self._cached = None
+        return applied
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._cached = None
+            self._live.clear()
+
+
+_STORE = _LiveStore()
+
+
+def get_settings(refresh: bool = False) -> Settings:
+    if refresh:
+        _STORE._cached = None  # force merge (tests / after env changes)
+    return _STORE.snapshot()
+
+
+def update_live_settings(updates: Mapping[str, Any]) -> dict[str, Any]:
+    return _STORE.update(updates)
+
+
+def invalidate_settings_cache() -> None:
+    _STORE.invalidate()

@@ -1,0 +1,112 @@
+"""Activity / event log.
+
+Port of the reference's tracing substrate (/root/reference/common.py:276-425):
+JSON events pushed to a capped global deque plus compact per-job lines, with a
+stage→label classifier. The reference kept these in Redis lists
+(``activity:log`` cap 2000, ``joblog:<id>`` cap 50000); here they are
+in-process ring buffers owned by the coordinator and served over its API.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Iterable
+
+_STAGE_LABELS = [
+    ("error", "ERROR"),
+    ("fail", "ERROR"),
+    ("segment", "SEGMENT"),
+    ("split", "SEGMENT"),
+    ("encode", "ENCODE"),
+    ("stitch", "STITCH"),
+    ("concat", "STITCH"),
+    ("finish", "FINISH"),
+    ("done", "FINISH"),
+    ("start", "START"),
+    ("stamp", "STAMP"),
+]
+
+
+def activity_label(stage: str) -> str:
+    s = (stage or "").lower()
+    for needle, label in _STAGE_LABELS:
+        if needle in s:
+            return label
+    return "INFO"
+
+
+class ActivityLog:
+    """Thread-safe capped event log with per-job sublogs."""
+
+    def __init__(self, cap: int = 2000, job_cap: int = 50000) -> None:
+        self._lock = threading.Lock()
+        self._events: collections.deque[dict[str, Any]] = collections.deque(maxlen=cap)
+        self._job_logs: dict[str, collections.deque[str]] = {}
+        self._job_cap = job_cap
+
+    def emit(
+        self,
+        stage: str,
+        message: str,
+        job_id: str | None = None,
+        host: str | None = None,
+        **fields: Any,
+    ) -> dict[str, Any]:
+        event = {
+            "ts": time.time(),
+            "stage": stage,
+            "label": activity_label(stage),
+            "message": message,
+            "job_id": job_id,
+            "host": host,
+        }
+        event.update(fields)
+        with self._lock:
+            self._events.appendleft(event)
+            if job_id is not None:
+                log = self._job_logs.setdefault(
+                    job_id, collections.deque(maxlen=self._job_cap)
+                )
+                log.append(self._format_line(event))
+        return event
+
+    @staticmethod
+    def _format_line(event: dict[str, Any]) -> str:
+        ts = time.strftime("%H:%M:%S", time.localtime(event["ts"]))
+        host = event.get("host") or "-"
+        extra = ""
+        if "part" in event:
+            extra += f" part={event['part']}"
+        if "elapsed_ms" in event:
+            extra += f" {event['elapsed_ms']:.0f}ms"
+        return f"{ts} {event['label']:<8} {host} {event['message']}{extra}"
+
+    def fetch(self, limit: int = 100) -> list[dict[str, Any]]:
+        with self._lock:
+            return list(self._events)[:limit]
+
+    def fetch_job(self, job_id: str, limit: int = 500) -> list[str]:
+        with self._lock:
+            log = self._job_logs.get(job_id)
+            if not log:
+                return []
+            return list(log)[-limit:]
+
+    def drop_job(self, job_id: str) -> None:
+        with self._lock:
+            self._job_logs.pop(job_id, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._job_logs.clear()
+
+
+def merge_events(logs: Iterable[ActivityLog], limit: int = 100) -> list[dict[str, Any]]:
+    merged: list[dict[str, Any]] = []
+    for log in logs:
+        merged.extend(log.fetch(limit))
+    merged.sort(key=lambda e: e["ts"], reverse=True)
+    return merged[:limit]

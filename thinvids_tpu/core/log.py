@@ -1,0 +1,40 @@
+"""Logging setup.
+
+Port of the reference's idempotent shared logger
+(/root/reference/common.py:100-161): one root configuration, format with
+hostname + pid, ``LOG_LEVEL`` env override, noisy third-party loggers quieted.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+
+_CONFIGURED = False
+_FORMAT = (
+    "%(asctime)s %(levelname)s {host} %(name)s [%(process)d] TVT %(message)s"
+)
+
+_QUIET = ("urllib3", "watchdog", "jax._src", "absl")
+
+
+def get_logging(name: str = "thinvids_tpu") -> logging.Logger:
+    global _CONFIGURED
+    if not _CONFIGURED:
+        level_name = os.environ.get("LOG_LEVEL", "INFO").upper()
+        level = getattr(logging, level_name, logging.INFO)
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter(_FORMAT.format(host=socket.gethostname()))
+        )
+        root = logging.getLogger()
+        root.setLevel(level)
+        # Idempotent: only attach our handler if a TVT handler is absent.
+        if not any(getattr(h, "_tvt", False) for h in root.handlers):
+            handler._tvt = True  # type: ignore[attr-defined]
+            root.addHandler(handler)
+        for quiet in _QUIET:
+            logging.getLogger(quiet).setLevel(logging.WARNING)
+        _CONFIGURED = True
+    return logging.getLogger(name)

@@ -1,0 +1,46 @@
+"""Job lifecycle states.
+
+Capability port of the reference's string-backed ``Status`` enum
+(/root/reference/common.py:72-97): READY, STARTING, WAITING, RUNNING,
+STAMPING, STOPPED, FAILED, REJECTED, DONE, with a lenient ``parse`` that
+accepts any case / surrounding whitespace and falls back to READY.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Status(str, enum.Enum):
+    READY = "ready"        # registered, not queued
+    WAITING = "waiting"    # queued for dispatch
+    STARTING = "starting"  # reserved by scheduler, warmup in progress
+    RUNNING = "running"    # encode pipeline active
+    STAMPING = "stamping"  # verification (watermark) encode active
+    STOPPED = "stopped"    # operator stop
+    FAILED = "failed"      # watchdog / retry-budget failure
+    REJECTED = "rejected"  # admission policy rejection
+    DONE = "done"          # output committed to library
+
+    @classmethod
+    def parse(cls, value: object, default: "Status | None" = None) -> "Status":
+        if isinstance(value, Status):
+            return value
+        if default is None:
+            default = cls.READY
+        if value is None:
+            return default
+        text = str(value).strip().lower()
+        for member in cls:
+            if member.value == text or member.name.lower() == text:
+                return member
+        return default
+
+    @property
+    def is_active(self) -> bool:
+        """True while the job occupies pipeline capacity."""
+        return self in (Status.STARTING, Status.RUNNING, Status.STAMPING)
+
+    @property
+    def is_terminal(self) -> bool:
+        return self in (Status.STOPPED, Status.FAILED, Status.REJECTED, Status.DONE)

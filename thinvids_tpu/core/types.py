@@ -1,0 +1,193 @@
+"""Core video data types.
+
+TPU-first framing: frames are numpy/JAX arrays of YUV planes padded to
+block-aligned shapes so every downstream kernel sees static, tile-friendly
+shapes. Descriptor dataclasses (GopSpec/SegmentPlan) are the typed analog of
+the reference's ~60-field Redis job hash (/root/reference/manager/app.py:2367)
+and its parts planning (/root/reference/worker/tasks.py:597-609).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import math
+from typing import Sequence
+
+import numpy as np
+
+
+class ChromaFormat(enum.Enum):
+    YUV400 = 0
+    YUV420 = 1
+    YUV422 = 2
+    YUV444 = 3
+
+    @property
+    def subsampling(self) -> tuple[int, int]:
+        """(horizontal, vertical) chroma divisors."""
+        return {
+            ChromaFormat.YUV400: (0, 0),
+            ChromaFormat.YUV420: (2, 2),
+            ChromaFormat.YUV422: (2, 1),
+            ChromaFormat.YUV444: (1, 1),
+        }[self]
+
+
+class FrameType(enum.IntEnum):
+    I = 0
+    P = 1
+    B = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class VideoMeta:
+    """Probe result for a source video (analog of the reference's ffprobe
+    surface, /root/reference/manager/app.py:2120-2220)."""
+
+    width: int
+    height: int
+    fps_num: int = 30
+    fps_den: int = 1
+    num_frames: int = 0
+    chroma: ChromaFormat = ChromaFormat.YUV420
+    bit_depth: int = 8
+    codec: str = "raw"
+    duration_s: float = 0.0
+    size_bytes: int = 0
+
+    @property
+    def fps(self) -> float:
+        return self.fps_num / max(1, self.fps_den)
+
+    @property
+    def mb_width(self) -> int:
+        return (self.width + 15) // 16
+
+    @property
+    def mb_height(self) -> int:
+        return (self.height + 15) // 16
+
+
+def pad_to_multiple(plane: np.ndarray, mult: int, fill: str = "edge") -> np.ndarray:
+    """Pad a 2-D plane up to a multiple of `mult` in both dims.
+
+    Edge replication matches encoder convention (padding never introduces
+    artificial gradients at the picture boundary).
+    """
+    h, w = plane.shape
+    ph = (mult - h % mult) % mult
+    pw = (mult - w % mult) % mult
+    if ph == 0 and pw == 0:
+        return plane
+    return np.pad(plane, ((0, ph), (0, pw)), mode=fill)
+
+
+@dataclasses.dataclass
+class Frame:
+    """One video frame as planar YUV arrays (uint8, full range of the
+    8-bit studio swing is preserved; no normalization).
+
+    Planes are stored UNpadded; kernels pad on ingest so the stored frame
+    remains the ground truth for quality metrics.
+    """
+
+    y: np.ndarray
+    u: np.ndarray | None = None
+    v: np.ndarray | None = None
+    pts: int = 0
+    frame_type: FrameType = FrameType.I
+
+    @property
+    def width(self) -> int:
+        return int(self.y.shape[1])
+
+    @property
+    def height(self) -> int:
+        return int(self.y.shape[0])
+
+    @property
+    def chroma(self) -> ChromaFormat:
+        if self.u is None:
+            return ChromaFormat.YUV400
+        ch, cw = self.u.shape
+        if cw == self.width // 2 and ch == self.height // 2:
+            return ChromaFormat.YUV420
+        if cw == self.width // 2 and ch == self.height:
+            return ChromaFormat.YUV422
+        return ChromaFormat.YUV444
+
+    def padded(self, mult: int = 16) -> "Frame":
+        u = self.u
+        v = self.v
+        if u is not None:
+            cmult = max(2, mult // (self.y.shape[1] // u.shape[1]))
+            u = pad_to_multiple(u, cmult)
+            v = pad_to_multiple(v, cmult)
+        return Frame(pad_to_multiple(self.y, mult), u, v, self.pts, self.frame_type)
+
+
+@dataclasses.dataclass(frozen=True)
+class GopSpec:
+    """A closed GOP: the unit of parallel work (the analog of a
+    reference 'part', /root/reference/worker/tasks.py:977-1052)."""
+
+    index: int            # GOP index within the job (concat order)
+    start_frame: int      # first frame (inclusive) in source order
+    num_frames: int       # frames in this GOP
+    idr: bool = True      # closed GOP: leading frame is an IDR
+
+    @property
+    def end_frame(self) -> int:
+        return self.start_frame + self.num_frames
+
+
+@dataclasses.dataclass(frozen=True)
+class SegmentPlan:
+    """Full sharding plan for a job: GOP boundaries + device layout.
+
+    Mirrors the reference parts-planner semantics: target work size per
+    shard, rounded up to a multiple of the usable worker (device) count so
+    waves fill the farm (/root/reference/worker/tasks.py:597-609,1019-1031).
+    """
+
+    gops: tuple[GopSpec, ...]
+    num_devices: int
+    frames_per_gop: int
+
+    @property
+    def num_gops(self) -> int:
+        return len(self.gops)
+
+    @property
+    def waves(self) -> int:
+        return math.ceil(self.num_gops / max(1, self.num_devices))
+
+
+@dataclasses.dataclass
+class EncodedSegment:
+    """One encoded GOP's bitstream + bookkeeping (the analog of an encoded
+    part PUT to the stitcher, /root/reference/worker/tasks.py:1667-1674)."""
+
+    gop: GopSpec
+    payload: bytes                    # Annex-B access units, concat-safe
+    frame_sizes: tuple[int, ...] = ()
+    distortion_sse: float = 0.0
+    elapsed_s: float = 0.0
+
+    @property
+    def size_bytes(self) -> int:
+        return len(self.payload)
+
+
+def concat_segments(segments: Sequence[EncodedSegment]) -> bytes:
+    """Order-restoring concat (the stitcher's frontier-ordered concat,
+    /root/reference/worker/tasks.py:2047-2069). Segments must be closed
+    GOPs starting with IDR + parameter sets so the join is seamless."""
+    ordered = sorted(segments, key=lambda s: s.gop.index)
+    expect = 0
+    for seg in ordered:
+        if seg.gop.index != expect:
+            raise ValueError(f"missing segment index {expect}")
+        expect += 1
+    return b"".join(s.payload for s in ordered)
