@@ -16,18 +16,27 @@ from thinvids_tpu.core.config import (
     as_bool,
     as_int,
     invalidate_settings_cache,
+    overlay_job_settings,
+    reset_live_settings,
     update_live_settings,
 )
-from thinvids_tpu.core.types import concat_segments, pad_to_multiple
+from thinvids_tpu.core.types import concat_segments, pad_to_multiple, pad_to_shape
 
 
 class TestStatus:
     def test_parse_lenient(self):
         assert Status.parse("RUNNING") is Status.RUNNING
         assert Status.parse("  done \n") is Status.DONE
-        assert Status.parse("garbage") is Status.READY
-        assert Status.parse(None) is Status.READY
         assert Status.parse(Status.FAILED) is Status.FAILED
+
+    def test_parse_unknown_raises(self):
+        # Matches the reference (common.py:95-97): corrupted status must not
+        # silently become schedulable.
+        with pytest.raises(ValueError):
+            Status.parse("garbage")
+        with pytest.raises(ValueError):
+            Status.parse(None)
+        assert Status.parse("garbage", default=Status.FAILED) is Status.FAILED
 
     def test_active_terminal(self):
         assert Status.RUNNING.is_active
@@ -39,10 +48,22 @@ class TestStatus:
 
 class TestConfig:
     def setup_method(self):
-        invalidate_settings_cache()
+        reset_live_settings()
 
     def teardown_method(self):
+        reset_live_settings()
+
+    def test_invalidate_keeps_live_overrides(self):
+        update_live_settings({"qp": 30})
         invalidate_settings_cache()
+        assert get_settings().qp == 30
+
+    def test_job_settings_overlay(self):
+        s = get_settings(refresh=True)
+        j = overlay_job_settings(s, {"qp": "99", "unknown": 1, "gop_frames": 8})
+        assert j.qp == 51 and j.gop_frames == 8
+        assert "unknown" not in j.values
+        assert get_settings().qp == DEFAULT_SETTINGS["qp"]  # base untouched
 
     def test_defaults(self):
         s = get_settings(refresh=True)
@@ -109,11 +130,49 @@ class TestTypes:
         assert f.y.shape == (32, 64)
         assert f.u.shape == (16, 32)
 
+    def test_frame_padded_422(self):
+        # ADVICE.md repro: 4:2:2 h=40 → luma pads to 48 rows, chroma must too.
+        y = np.zeros((40, 64), np.uint8)
+        u = np.zeros((40, 32), np.uint8)
+        f = Frame(y, u, u.copy()).padded(16)
+        assert f.y.shape == (48, 64)
+        assert f.u.shape == (48, 32)
+
+    def test_frame_padded_odd_420(self):
+        # ADVICE.md repro: w=33 (chroma 17) → luma 48 cols, chroma 24 cols.
+        y = np.zeros((32, 33), np.uint8)
+        u = np.zeros((16, 17), np.uint8)
+        f = Frame(y, u, u.copy()).padded(16)
+        assert f.y.shape == (32, 48)
+        assert f.u.shape == (16, 24)
+
+    def test_frame_missing_v_raises(self):
+        y = np.zeros((16, 16), np.uint8)
+        u = np.zeros((8, 8), np.uint8)
+        with pytest.raises(ValueError):
+            Frame(y, u, None).padded(16)
+
+    def test_pad_to_shape(self):
+        p = np.arange(6, dtype=np.uint8).reshape(2, 3)
+        out = pad_to_shape(p, 4, 4)
+        assert out.shape == (4, 4) and out[3, 3] == p[1, 2]
+        with pytest.raises(ValueError):
+            pad_to_shape(p, 1, 3)
+
     def test_concat_order_and_missing(self):
         segs = [
             EncodedSegment(GopSpec(1, 32, 32), b"b"),
             EncodedSegment(GopSpec(0, 0, 32), b"a"),
         ]
         assert concat_segments(segs) == b"ab"
-        with pytest.raises(ValueError):
+        with pytest.raises(ValueError, match="missing"):
             concat_segments([EncodedSegment(GopSpec(1, 32, 32), b"b")])
+
+    def test_concat_duplicate_reports_duplicate(self):
+        # Retry re-dispatch produces duplicates; the error must say so.
+        segs = [
+            EncodedSegment(GopSpec(0, 0, 32), b"a"),
+            EncodedSegment(GopSpec(0, 0, 32), b"a2"),
+        ]
+        with pytest.raises(ValueError, match="duplicate"):
+            concat_segments(segs)

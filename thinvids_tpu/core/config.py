@@ -119,6 +119,13 @@ _CLAMPS: dict[str, Callable[[Any], Any]] = {
 }
 
 
+def _validate_setting(key: str, raw: Any) -> Any:
+    """Clamp-or-coerce one setting value; shared by the live tier and the
+    per-job overlay so both validate identically."""
+    clamp = _CLAMPS.get(key)
+    return clamp(raw) if clamp else _coerce_like(DEFAULT_SETTINGS[key], raw)
+
+
 @dataclasses.dataclass(frozen=True)
 class Settings:
     """Immutable snapshot of merged settings at read time."""
@@ -173,14 +180,20 @@ class _LiveStore:
             for key, raw in updates.items():
                 if key not in DEFAULT_SETTINGS:
                     continue
-                clamp = _CLAMPS.get(key)
-                value = clamp(raw) if clamp else _coerce_like(DEFAULT_SETTINGS[key], raw)
+                value = _validate_setting(key, raw)
                 self._live[key] = value
                 applied[key] = value
             self._cached = None
         return applied
 
-    def invalidate(self) -> None:
+    def drop_cache(self) -> None:
+        """Clear only the TTL read cache; live overrides survive (the
+        reference's invalidate_settings_cache semantics)."""
+        with self._lock:
+            self._cached = None
+
+    def reset(self) -> None:
+        """Wipe live overrides AND the cache — tests / cluster reset only."""
         with self._lock:
             self._cached = None
             self._live.clear()
@@ -191,7 +204,7 @@ _STORE = _LiveStore()
 
 def get_settings(refresh: bool = False) -> Settings:
     if refresh:
-        _STORE._cached = None  # force merge (tests / after env changes)
+        _STORE.drop_cache()
     return _STORE.snapshot()
 
 
@@ -200,4 +213,34 @@ def update_live_settings(updates: Mapping[str, Any]) -> dict[str, Any]:
 
 
 def invalidate_settings_cache() -> None:
-    _STORE.invalidate()
+    """Drop the read cache so the next read re-merges env + live tiers.
+
+    Unlike round 1, this does NOT wipe live overrides (that surprising
+    behavior diverged from the reference, /root/reference/common.py:226-229);
+    use :func:`reset_live_settings` for a full wipe.
+    """
+    _STORE.drop_cache()
+
+
+def reset_live_settings() -> None:
+    _STORE.reset()
+
+
+# Per-job settings tier (SURVEY §5.6 tier 4): keys a job record may override,
+# mirroring the reference's job-hash settings editable while not RUNNING
+# (/root/reference/manager/app.py:2746-2812).
+JOB_SETTING_KEYS = frozenset(
+    {"gop_frames", "target_segment_frames", "qp", "target_height", "rc_mode",
+     "max_segments", "software_fallback"}
+)
+
+
+def overlay_job_settings(base: Settings, overrides: Mapping[str, Any]) -> Settings:
+    """Apply a job's per-job overrides on top of a settings snapshot, with
+    the same clamping/coercion the live tier gets. Unknown keys ignored."""
+    merged = dict(base.values)
+    for key, raw in overrides.items():
+        if key not in JOB_SETTING_KEYS:
+            continue
+        merged[key] = _validate_setting(key, raw)
+    return Settings(values=merged)

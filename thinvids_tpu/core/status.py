@@ -2,8 +2,10 @@
 
 Capability port of the reference's string-backed ``Status`` enum
 (/root/reference/common.py:72-97): READY, STARTING, WAITING, RUNNING,
-STAMPING, STOPPED, FAILED, REJECTED, DONE, with a lenient ``parse`` that
-accepts any case / surrounding whitespace and falls back to READY.
+STAMPING, STOPPED, FAILED, REJECTED, DONE. ``parse`` accepts any case /
+surrounding whitespace but, like the reference, raises on unknown values —
+a corrupted persisted status must never silently become schedulable again.
+Callers that want a fallback pass ``default=`` explicitly.
 """
 
 from __future__ import annotations
@@ -26,14 +28,13 @@ class Status(str, enum.Enum):
     def parse(cls, value: object, default: "Status | None" = None) -> "Status":
         if isinstance(value, Status):
             return value
+        if value is not None:
+            text = str(value).strip().lower()
+            for member in cls:
+                if member.value == text or member.name.lower() == text:
+                    return member
         if default is None:
-            default = cls.READY
-        if value is None:
-            return default
-        text = str(value).strip().lower()
-        for member in cls:
-            if member.value == text or member.name.lower() == text:
-                return member
+            raise ValueError(f"unknown status: {value!r}")
         return default
 
     @property

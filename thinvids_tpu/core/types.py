@@ -24,10 +24,18 @@ class ChromaFormat(enum.Enum):
     YUV444 = 3
 
     @property
+    def has_chroma(self) -> bool:
+        return self is not ChromaFormat.YUV400
+
+    @property
     def subsampling(self) -> tuple[int, int]:
-        """(horizontal, vertical) chroma divisors."""
+        """(horizontal, vertical) chroma divisors.
+
+        YUV400 reports (1, 1) so generic ``dim // divisor`` callers never
+        divide by zero; gate on :attr:`has_chroma` before touching chroma.
+        """
         return {
-            ChromaFormat.YUV400: (0, 0),
+            ChromaFormat.YUV400: (1, 1),
             ChromaFormat.YUV420: (2, 2),
             ChromaFormat.YUV422: (2, 1),
             ChromaFormat.YUV444: (1, 1),
@@ -83,6 +91,16 @@ def pad_to_multiple(plane: np.ndarray, mult: int, fill: str = "edge") -> np.ndar
     return np.pad(plane, ((0, ph), (0, pw)), mode=fill)
 
 
+def pad_to_shape(plane: np.ndarray, h: int, w: int, fill: str = "edge") -> np.ndarray:
+    """Pad a 2-D plane up to an exact (h, w) target with edge replication."""
+    ch, cw = plane.shape
+    if ch > h or cw > w:
+        raise ValueError(f"plane {plane.shape} larger than target {(h, w)}")
+    if (ch, cw) == (h, w):
+        return plane
+    return np.pad(plane, ((0, h - ch), (0, w - cw)), mode=fill)
+
+
 @dataclasses.dataclass
 class Frame:
     """One video frame as planar YUV arrays (uint8, full range of the
@@ -106,25 +124,41 @@ class Frame:
     def height(self) -> int:
         return int(self.y.shape[0])
 
+    def _chroma_divisors(self) -> tuple[int, int]:
+        """(horizontal, vertical) divisors inferred from u-plane shape via
+        per-axis ceil-division ratios (robust to odd source dimensions)."""
+        ch, cw = self.u.shape
+        hdiv = 2 if cw == (self.width + 1) // 2 else 1
+        vdiv = 2 if ch == (self.height + 1) // 2 else 1
+        if (hdiv, vdiv) == (1, 2):
+            raise ValueError("4:4:0 chroma layout is not supported")
+        return hdiv, vdiv
+
     @property
     def chroma(self) -> ChromaFormat:
         if self.u is None:
             return ChromaFormat.YUV400
-        ch, cw = self.u.shape
-        if cw == self.width // 2 and ch == self.height // 2:
-            return ChromaFormat.YUV420
-        if cw == self.width // 2 and ch == self.height:
-            return ChromaFormat.YUV422
-        return ChromaFormat.YUV444
+        return {
+            (2, 2): ChromaFormat.YUV420,
+            (2, 1): ChromaFormat.YUV422,
+            (1, 1): ChromaFormat.YUV444,
+        }[divisors]
 
     def padded(self, mult: int = 16) -> "Frame":
+        """Pad planes so luma is a multiple of ``mult`` in both dims and each
+        chroma plane is exactly padded_luma_dim // divisor per axis (the
+        invariant every block kernel assumes)."""
+        y = pad_to_multiple(self.y, mult)
         u = self.u
         v = self.v
+        if (u is None) != (v is None):
+            raise ValueError("frame must have both u and v planes, or neither")
         if u is not None:
-            cmult = max(2, mult // (self.y.shape[1] // u.shape[1]))
-            u = pad_to_multiple(u, cmult)
-            v = pad_to_multiple(v, cmult)
-        return Frame(pad_to_multiple(self.y, mult), u, v, self.pts, self.frame_type)
+            ph, pw = y.shape
+            hdiv, vdiv = self._chroma_divisors()
+            u = pad_to_shape(u, ph // vdiv, pw // hdiv)
+            v = pad_to_shape(v, ph // vdiv, pw // hdiv)
+        return Frame(y, u, v, self.pts, self.frame_type)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -187,7 +221,9 @@ def concat_segments(segments: Sequence[EncodedSegment]) -> bytes:
     ordered = sorted(segments, key=lambda s: s.gop.index)
     expect = 0
     for seg in ordered:
-        if seg.gop.index != expect:
+        if seg.gop.index < expect:
+            raise ValueError(f"duplicate segment index {seg.gop.index}")
+        if seg.gop.index > expect:
             raise ValueError(f"missing segment index {expect}")
         expect += 1
     return b"".join(s.payload for s in ordered)
