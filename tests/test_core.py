@@ -146,6 +146,20 @@ class TestTypes:
         assert f.y.shape == (32, 48)
         assert f.u.shape == (16, 24)
 
+    def test_frame_chroma_classification(self):
+        y = np.zeros((32, 64), np.uint8)
+        c420 = np.zeros((16, 32), np.uint8)
+        c422 = np.zeros((32, 32), np.uint8)
+        c444 = np.zeros((32, 64), np.uint8)
+        from thinvids_tpu.core import ChromaFormat
+        assert Frame(y, c420, c420).chroma is ChromaFormat.YUV420
+        assert Frame(y, c422, c422).chroma is ChromaFormat.YUV422
+        assert Frame(y, c444, c444).chroma is ChromaFormat.YUV444
+        assert Frame(y).chroma is ChromaFormat.YUV400
+        c440 = np.zeros((16, 64), np.uint8)
+        with pytest.raises(ValueError, match="4:4:0"):
+            Frame(y, c440, c440).chroma
+
     def test_frame_missing_v_raises(self):
         y = np.zeros((16, 16), np.uint8)
         u = np.zeros((8, 8), np.uint8)

@@ -1,0 +1,152 @@
+"""Tests for io: bit primitives, exp-golomb, NAL framing, y4m round-trip."""
+
+import io
+
+import numpy as np
+import pytest
+
+from thinvids_tpu.core.types import ChromaFormat, Frame, VideoMeta
+from thinvids_tpu.io.bits import (
+    BitReader,
+    BitWriter,
+    annexb_nal,
+    ebsp_to_rbsp,
+    rbsp_to_ebsp,
+    split_annexb,
+)
+from thinvids_tpu.io.y4m import Y4MReader, Y4MWriter, frames_to_bytes
+
+
+class TestBitWriter:
+    def test_known_ue_codewords(self):
+        # H.264 §9.1 Table 9-2: 0→1, 1→010, 2→011, 3→00100, 7→0001000
+        for value, bits in [(0, "1"), (1, "010"), (2, "011"), (3, "00100"), (7, "0001000")]:
+            w = BitWriter()
+            w.ue(value)
+            w.byte_align()
+            got = "".join(f"{b:08b}" for b in w.getvalue())[: len(bits)]
+            assert got == bits, value
+
+    def test_known_se_codewords(self):
+        # §9.1.1: 0→1, 1→010, -1→011, 2→00100, -2→00101
+        for value, bits in [(0, "1"), (1, "010"), (-1, "011"), (2, "00100"), (-2, "00101")]:
+            w = BitWriter()
+            w.se(value)
+            w.byte_align()
+            got = "".join(f"{b:08b}" for b in w.getvalue())[: len(bits)]
+            assert got == bits, value
+
+    def test_roundtrip_mixed(self):
+        w = BitWriter()
+        values = [0, 1, 5, 255, 1023, 70000]
+        for v in values:
+            w.ue(v)
+        svalues = [0, -1, 1, -40, 1000]
+        for v in svalues:
+            w.se(v)
+        w.write(0x5A, 8)
+        w.rbsp_trailing_bits()
+        r = BitReader(w.getvalue())
+        assert [r.ue() for _ in values] == values
+        assert [r.se() for _ in svalues] == svalues
+        assert r.read(8) == 0x5A
+
+    def test_unflushed_raises(self):
+        w = BitWriter()
+        w.write(1, 3)
+        with pytest.raises(ValueError):
+            w.getvalue()
+
+    def test_value_too_wide_raises(self):
+        w = BitWriter()
+        with pytest.raises(ValueError):
+            w.write(4, 2)
+
+    def test_more_rbsp_data(self):
+        w = BitWriter()
+        w.ue(3)
+        w.rbsp_trailing_bits()
+        r = BitReader(w.getvalue())
+        assert r.more_rbsp_data()
+        r.ue()
+        assert not r.more_rbsp_data()
+
+
+class TestEmulationPrevention:
+    @pytest.mark.parametrize(
+        "rbsp,ebsp",
+        [
+            (b"\x00\x00\x00", b"\x00\x00\x03\x00"),
+            (b"\x00\x00\x01", b"\x00\x00\x03\x01"),
+            (b"\x00\x00\x02", b"\x00\x00\x03\x02"),
+            (b"\x00\x00\x03", b"\x00\x00\x03\x03"),
+            (b"\x00\x00\x04", b"\x00\x00\x04"),
+            (b"\x01\x02\x03", b"\x01\x02\x03"),
+            (b"\x00\x00\x00\x00\x00", b"\x00\x00\x03\x00\x00\x03\x00"),
+        ],
+    )
+    def test_vectors(self, rbsp, ebsp):
+        assert rbsp_to_ebsp(rbsp) == ebsp
+        assert ebsp_to_rbsp(ebsp) == rbsp
+
+    def test_random_roundtrip(self):
+        rng = np.random.default_rng(0)
+        for _ in range(50):
+            data = bytes(rng.integers(0, 4, size=rng.integers(0, 64), dtype=np.uint8))
+            assert ebsp_to_rbsp(rbsp_to_ebsp(data)) == data
+
+    def test_nal_and_split(self):
+        rbsp1 = b"\x42\x00\x00\x01\x99"
+        rbsp2 = b"\x68\xee"
+        stream = annexb_nal(3, 7, rbsp1) + annexb_nal(3, 8, rbsp2, long_start_code=False)
+        assert b"\x00\x00\x01\x99" not in stream[4:]  # emulation prevented
+        units = split_annexb(stream)
+        assert [(u[0], u[1]) for u in units] == [(3, 7), (3, 8)]
+        assert units[0][2] == rbsp1
+        assert units[1][2] == rbsp2
+
+
+class TestY4M:
+    def _clip(self, w, h, n, chroma=ChromaFormat.YUV420):
+        rng = np.random.default_rng(1)
+        frames = []
+        hdiv, vdiv = chroma.subsampling
+        for i in range(n):
+            y = rng.integers(0, 256, (h, w), dtype=np.uint8)
+            if chroma.has_chroma:
+                u = rng.integers(0, 256, (h // vdiv, w // hdiv), dtype=np.uint8)
+                v = rng.integers(0, 256, (h // vdiv, w // hdiv), dtype=np.uint8)
+            else:
+                u = v = None
+            frames.append(Frame(y, u, v, pts=i))
+        meta = VideoMeta(width=w, height=h, fps_num=25, fps_den=1, chroma=chroma)
+        return meta, frames
+
+    @pytest.mark.parametrize(
+        "chroma", [ChromaFormat.YUV420, ChromaFormat.YUV422, ChromaFormat.YUV444, ChromaFormat.YUV400]
+    )
+    def test_roundtrip(self, chroma):
+        meta, frames = self._clip(32, 16, 3, chroma)
+        data = frames_to_bytes(meta, frames)
+        reader = Y4MReader(io.BytesIO(data))
+        assert reader.width == 32 and reader.height == 16
+        assert reader.fps_num == 25
+        assert reader.chroma is chroma
+        out = list(reader)
+        assert len(out) == 3
+        for a, b in zip(frames, out):
+            assert (a.y == b.y).all()
+            if chroma.has_chroma:
+                assert (a.u == b.u).all() and (a.v == b.v).all()
+
+    def test_rejects_non_y4m(self):
+        with pytest.raises(ValueError):
+            Y4MReader(io.BytesIO(b"RIFFxxxx\n"))
+
+    def test_size_mismatch_raises(self):
+        meta, frames = self._clip(32, 16, 1)
+        buf = io.BytesIO()
+        w = Y4MWriter(buf, meta)
+        bad = Frame(np.zeros((8, 8), np.uint8))
+        with pytest.raises(ValueError):
+            w.write(bad)

@@ -1,0 +1,8 @@
+"""Codec implementations — the framework's "native compute" layer.
+
+The reference delegated all codec work to external ffmpeg processes
+(/root/reference/worker/tasks.py:1354-1737). Here the encoder IS the
+framework: integer transforms, intra prediction, quantization and entropy
+coding implemented from the H.264 spec, with the blockwise math running as
+JAX/Pallas programs on TPU and the sequential entropy pack on host.
+"""

@@ -1,0 +1,29 @@
+"""H.264/AVC baseline-profile intra codec (CAVLC, I16x16).
+
+Built from ITU-T H.264 (Rec. 08/2021) semantics:
+- 4x4 integer core transform + 4x4/2x2 Hadamard DC transforms (§8.5)
+- I16x16 luma and 8x8 chroma intra prediction (§8.3)
+- CAVLC residual coding (§9.2) with the Table 9-5/9-7/9-8/9-10 VLCs
+- Annex-B byte streams: SPS/PPS/IDR slices, deblocking disabled via
+  slice header so reconstruction is filter-free and bit-exactly testable.
+
+The encode hot path (prediction, transform, quant, reconstruction) runs as
+a jitted JAX program scanning macroblock rows; entropy packing is host-side.
+An independent decoder (decoder.py) plus a ctypes libavcodec oracle give
+two-sided conformance coverage.
+"""
+
+__all__ = ["H264Encoder", "encode_frames", "SPS", "PPS"]
+
+
+def __getattr__(name):  # lazy: keep table/transform imports light
+    if name in __all__:
+        from . import encoder, headers
+
+        return {
+            "H264Encoder": encoder.H264Encoder,
+            "encode_frames": encoder.encode_frames,
+            "SPS": headers.SPS,
+            "PPS": headers.PPS,
+        }[name]
+    raise AttributeError(name)

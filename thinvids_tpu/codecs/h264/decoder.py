@@ -1,0 +1,155 @@
+"""H.264 baseline intra decoder (subset matching the encoder's profile).
+
+Independent implementation of the decode direction — parses Annex-B
+streams (SPS/PPS/IDR, CAVLC, I16x16) and reconstructs frames. Used by
+tests as the in-repo conformance check of encoder output (alongside the
+libavcodec ctypes oracle) and by the stamp/seam verification tooling to
+decode without external binaries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..h264 import cavlc
+from ...core.types import ChromaFormat, Frame, VideoMeta
+from ...io.bits import BitReader, split_annexb
+from .headers import (
+    NAL_PPS,
+    NAL_SLICE_IDR,
+    NAL_SLICE_NON_IDR,
+    NAL_SPS,
+    PPS,
+    SLICE_TYPE_I,
+    SPS,
+    SliceHeader,
+)
+from .intra import (
+    CHROMA_BLOCK_ORDER,
+    LUMA_BLOCK_ORDER,
+    predict_chroma8,
+    predict_luma16,
+    reconstruct_chroma8,
+    reconstruct_luma16,
+)
+from .transform import chroma_qp
+
+
+@dataclasses.dataclass
+class DecodedStream:
+    meta: VideoMeta
+    frames: list[Frame]
+
+
+def _decode_islice(br: BitReader, sps: SPS, header: SliceHeader
+                   ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    mbw, mbh = sps.mb_width, sps.mb_height
+    y = np.zeros((16 * mbh, 16 * mbw), np.uint8)
+    u = np.zeros((8 * mbh, 8 * mbw), np.uint8)
+    v = np.zeros((8 * mbh, 8 * mbw), np.uint8)
+    luma_counts = np.zeros((4 * mbh, 4 * mbw), np.int32)
+    chroma_counts = np.zeros((2, 2 * mbh, 2 * mbw), np.int32)
+    qp = header.qp
+
+    for my in range(mbh):
+        for mx in range(mbw):
+            mb_type = br.ue()
+            if not 1 <= mb_type <= 24:
+                raise ValueError(f"unsupported I mb_type {mb_type}")
+            luma_mode = (mb_type - 1) % 4
+            cbp_chroma = ((mb_type - 1) // 4) % 3
+            cbp_luma = 15 if (mb_type - 1) >= 12 else 0
+            chroma_mode = br.ue()
+            qp += br.se()                       # mb_qp_delta
+            qpc = chroma_qp(qp)
+
+            by0, bx0 = 4 * my, 4 * mx
+            na = int(luma_counts[by0, bx0 - 1]) if bx0 > 0 else None
+            nb = int(luma_counts[by0 - 1, bx0]) if by0 > 0 else None
+            luma_dc = np.array(
+                cavlc.decode_residual(br, cavlc.luma_nc(na, nb), 16), np.int32)
+
+            luma_ac = np.zeros((16, 15), np.int32)
+            for bi, (bx, by) in enumerate(LUMA_BLOCK_ORDER):
+                gy, gx = by0 + by, bx0 + bx
+                if cbp_luma:
+                    na = int(luma_counts[gy, gx - 1]) if gx > 0 else None
+                    nb = int(luma_counts[gy - 1, gx]) if gy > 0 else None
+                    coeffs = cavlc.decode_residual(br, cavlc.luma_nc(na, nb), 15)
+                    luma_ac[bi] = coeffs
+                    luma_counts[gy, gx] = sum(1 for c in coeffs if c)
+                else:
+                    luma_counts[gy, gx] = 0
+
+            chroma_dc = np.zeros((2, 4), np.int32)
+            if cbp_chroma > 0:
+                for ci in range(2):
+                    chroma_dc[ci] = cavlc.decode_residual(br, -1, 4)
+            chroma_ac = np.zeros((2, 4, 15), np.int32)
+            cy0, cx0 = 2 * my, 2 * mx
+            for ci in range(2):
+                for bi, (bx, by) in enumerate(CHROMA_BLOCK_ORDER):
+                    gy, gx = cy0 + by, cx0 + bx
+                    if cbp_chroma == 2:
+                        na = int(chroma_counts[ci, gy, gx - 1]) if gx > 0 else None
+                        nb = int(chroma_counts[ci, gy - 1, gx]) if gy > 0 else None
+                        coeffs = cavlc.decode_residual(
+                            br, cavlc.luma_nc(na, nb), 15)
+                        chroma_ac[ci, bi] = coeffs
+                        chroma_counts[ci, gy, gx] = sum(1 for c in coeffs if c)
+                    else:
+                        chroma_counts[ci, gy, gx] = 0
+
+            # Reconstruct.
+            top = y[16 * my - 1, 16 * mx:16 * mx + 16] if my > 0 else None
+            left = y[16 * my:16 * my + 16, 16 * mx - 1] if mx > 0 else None
+            tl = int(y[16 * my - 1, 16 * mx - 1]) if (my > 0 and mx > 0) else None
+            pred = predict_luma16(luma_mode, top, left, tl)
+            y[16 * my:16 * my + 16, 16 * mx:16 * mx + 16] = reconstruct_luma16(
+                pred, luma_dc, luma_ac, qp)
+            for ci, plane in enumerate((u, v)):
+                ctop = plane[8 * my - 1, 8 * mx:8 * mx + 8] if my > 0 else None
+                cleft = plane[8 * my:8 * my + 8, 8 * mx - 1] if mx > 0 else None
+                ctl = int(plane[8 * my - 1, 8 * mx - 1]) if (my > 0 and mx > 0) else None
+                cpred = predict_chroma8(chroma_mode, ctop, cleft, ctl)
+                plane[8 * my:8 * my + 8, 8 * mx:8 * mx + 8] = reconstruct_chroma8(
+                    cpred, chroma_dc[ci], chroma_ac[ci], qpc)
+    return y, u, v
+
+
+def decode_annexb(stream: bytes) -> DecodedStream:
+    """Decode an Annex-B byte stream produced by this package's encoder."""
+    sps: SPS | None = None
+    pps: PPS | None = None
+    frames: list[Frame] = []
+    for nal_ref_idc, nal_type, rbsp in split_annexb(stream):
+        if nal_type == NAL_SPS:
+            sps = SPS.parse_rbsp(rbsp)
+        elif nal_type == NAL_PPS:
+            pps = PPS.parse_rbsp(rbsp)
+        elif nal_type in (NAL_SLICE_IDR, NAL_SLICE_NON_IDR):
+            if sps is None or pps is None:
+                raise ValueError("slice before parameter sets")
+            br = BitReader(rbsp)
+            header = SliceHeader.parse(br, sps, pps, nal_type, nal_ref_idc)
+            if header.first_mb != 0:
+                raise ValueError("multi-slice pictures not supported")
+            if header.slice_type != SLICE_TYPE_I:
+                raise ValueError("only I slices supported (v1)")
+            if not header.disable_deblocking:
+                raise ValueError("deblocking not implemented; stream must disable it")
+            y, u, v = _decode_islice(br, sps, header)
+            # Crop to display size.
+            w, h = sps.width, sps.height
+            frames.append(Frame(
+                y[:h, :w], u[:h // 2, :w // 2], v[:h // 2, :w // 2],
+                pts=len(frames)))
+    if sps is None:
+        raise ValueError("no SPS in stream")
+    meta = VideoMeta(width=sps.width, height=sps.height,
+                     fps_num=sps.fps_num, fps_den=sps.fps_den,
+                     num_frames=len(frames), chroma=ChromaFormat.YUV420,
+                     codec="h264", size_bytes=len(stream))
+    return DecodedStream(meta=meta, frames=frames)

@@ -142,7 +142,7 @@ class Frame:
             (2, 2): ChromaFormat.YUV420,
             (2, 1): ChromaFormat.YUV422,
             (1, 1): ChromaFormat.YUV444,
-        }[divisors]
+        }[self._chroma_divisors()]
 
     def padded(self, mult: int = 16) -> "Frame":
         """Pad planes so luma is a multiple of ``mult`` in both dims and each

@@ -1,0 +1,22 @@
+"""Container / bitstream IO.
+
+The reference leans on ffmpeg/ffprobe for every byte of container work
+(/root/reference/worker/tasks.py:190-268, manager/app.py:2120-2220). This
+package is the from-scratch replacement: raw bit/NAL primitives, YUV4MPEG2
+(y4m) frame IO, Annex-B elementary streams, and a pure-Python probe.
+"""
+
+from .bits import BitReader, BitWriter, annexb_nal, ebsp_to_rbsp, rbsp_to_ebsp
+from .y4m import Y4MReader, Y4MWriter, read_y4m, write_y4m
+
+__all__ = [
+    "BitReader",
+    "BitWriter",
+    "annexb_nal",
+    "ebsp_to_rbsp",
+    "rbsp_to_ebsp",
+    "Y4MReader",
+    "Y4MWriter",
+    "read_y4m",
+    "write_y4m",
+]

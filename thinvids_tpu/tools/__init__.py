@@ -1,0 +1,7 @@
+"""Verification & quality tooling: conformance oracle, metrics, stamp tests.
+
+The reference verified correctness operationally (visual stamp() checks,
+/root/reference/worker/tasks.py:2314-2613); here verification is automated:
+an external-decoder oracle, PSNR harnesses, and seam tests are part of the
+framework and its CI.
+"""
