@@ -1,8 +1,12 @@
 """Test configuration.
 
 Tests run on CPU with 8 virtual devices so multi-chip sharding paths
-(`shard_map` over a Mesh) are exercised without TPU hardware — the JAX-native
-"fake cluster" (SURVEY.md §4). Must run before any jax import.
+(`shard_map` over a Mesh) are exercised without TPU hardware — the
+JAX-native "fake cluster" (SURVEY.md §4).
+
+Note: this image boots an `axon` (tunneled TPU) PJRT plugin from
+sitecustomize which force-selects `jax_platforms=axon,cpu`; env vars alone
+cannot override that, so we update the jax config directly after import.
 """
 
 import os
@@ -12,4 +16,7 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

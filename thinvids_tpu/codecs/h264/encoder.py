@@ -173,14 +173,33 @@ def mb_cbp(levels: FrameLevels, mi: int) -> tuple[int, int]:
 
 def pack_slice(levels: FrameLevels, mbw: int, mbh: int, sps: SPS, pps: PPS,
                qp: int, frame_num: int = 0, idr: bool = True,
-               idr_pic_id: int = 0) -> bytes:
-    """Entropy-pack one I-slice picture into an Annex-B NAL unit."""
+               idr_pic_id: int = 0, native: bool | None = None) -> bytes:
+    """Entropy-pack one I-slice picture into an Annex-B NAL unit.
+
+    `native=None` auto-selects the C++ packer when buildable; False forces
+    the pure-Python reference path (both produce identical bits — tested).
+    """
     bw = BitWriter()
     header = SliceHeader(
         slice_type=SLICE_TYPE_I, frame_num=frame_num, idr=idr, qp=qp,
         idr_pic_id=idr_pic_id,
     )
     header.write(bw, sps, pps)
+
+    if native is not False:
+        from ... import native as native_mod
+
+        if native_mod.available():
+            hdr_bytes, hdr_bits = bw.getvalue_unaligned()
+            ebsp = native_mod.pack_islice(
+                hdr_bytes, hdr_bits, levels.luma_mode, levels.chroma_mode,
+                levels.luma_dc, levels.luma_ac, levels.chroma_dc,
+                levels.chroma_ac, mbw, mbh)
+            start = b"\x00\x00\x00\x01"
+            nal_header = bytes([(3 << 5) | (NAL_SLICE_IDR if idr else 1)])
+            return start + nal_header + ebsp
+        if native:
+            raise RuntimeError("native packer requested but unavailable")
 
     # nC neighbor maps: total_coeff per 4x4 luma / chroma block.
     luma_counts = np.zeros((4 * mbh, 4 * mbw), np.int32)

@@ -1,0 +1,257 @@
+"""JAX/TPU implementation of the intra encode compute path.
+
+Bit-exact port of encoder.encode_frame_arrays (tested against it): the
+whole prediction→transform→quant→reconstruction loop runs as one jitted
+XLA program. Structure chosen for the TPU execution model:
+
+- macroblock ROW 0 has a left-neighbor dependency (DC/H modes) → a small
+  `lax.scan` over its MBs;
+- every other row uses VERTICAL prediction, which depends only on the
+  reconstructed bottom edge of the row above → `lax.scan` over rows with
+  all MBs of a row computed as one vectorized batch (VPU-friendly int32
+  ops over (mbw, 16, 16) tiles, static shapes, no data-dependent control
+  flow).
+
+The sequential entropy pack stays on host (codecs/h264/encoder.pack_slice
+or the C++ packer); this module only produces level arrays.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .encoder import FrameLevels, _mode_policy
+from .intra import LUMA_BLOCK_ORDER
+from .transform import MF_TABLE, V_TABLE, ZIGZAG_4x4, CHROMA_QP_TABLE
+
+_MF = jnp.asarray(MF_TABLE)          # (6, 4, 4)
+_V = jnp.asarray(V_TABLE)            # (6, 4, 4)
+_ZZ = jnp.asarray(ZIGZAG_4x4)        # (16,)
+_QPC = jnp.asarray(CHROMA_QP_TABLE)  # (52,)
+_CF = jnp.asarray([[1, 1, 1, 1], [2, 1, -1, -2], [1, -1, -1, 1], [1, -2, 2, -1]],
+                  dtype=jnp.int32)
+_H4 = jnp.asarray([[1, 1, 1, 1], [1, 1, -1, -1], [1, -1, -1, 1], [1, -1, 1, -1]],
+                  dtype=jnp.int32)
+_H2 = jnp.asarray([[1, 1], [1, -1]], dtype=jnp.int32)
+# raster (by*4+bx) index for each z-scan position
+_ZSCAN = jnp.asarray([by * 4 + bx for (bx, by) in LUMA_BLOCK_ORDER])
+
+
+def _fwd4(x):
+    return jnp.einsum("ij,...jk,lk->...il", _CF, x, _CF)
+
+
+def _inv4(d):
+    d0, d1, d2, d3 = d[..., 0], d[..., 1], d[..., 2], d[..., 3]
+    e0, e1 = d0 + d2, d0 - d2
+    e2, e3 = (d1 >> 1) - d3, d1 + (d3 >> 1)
+    f = jnp.stack([e0 + e3, e1 + e2, e1 - e2, e0 - e3], axis=-1)
+    g0, g1, g2, g3 = f[..., 0, :], f[..., 1, :], f[..., 2, :], f[..., 3, :]
+    h0, h1 = g0 + g2, g0 - g2
+    h2, h3 = (g1 >> 1) - g3, g1 + (g3 >> 1)
+    return jnp.stack([h0 + h3, h1 + h2, h1 - h2, h0 - h3], axis=-2)
+
+
+def _quant(w, qp, skip_dc):
+    qbits = 15 + qp // 6
+    f = (1 << qbits) // 3
+    mf = _MF[qp % 6]
+    z = (jnp.abs(w) * mf + f) >> qbits
+    z = jnp.where(w < 0, -z, z)
+    if skip_dc:
+        z = z.at[..., 0, 0].set(0)
+    return z
+
+
+def _dequant(z, qp):
+    return (z * _V[qp % 6]) << (qp // 6)
+
+
+def _zigzag(b):
+    return b.reshape(*b.shape[:-2], 16)[..., _ZZ]
+
+
+def _inv_zigzag(seq):
+    out = jnp.zeros_like(seq)
+    out = out.at[..., _ZZ].set(seq)
+    return out.reshape(*seq.shape[:-1], 4, 4)
+
+
+def _luma_dc_quant(wd, qp):
+    qbits = 15 + qp // 6
+    f = (1 << qbits) // 3
+    mf00 = _MF[qp % 6, 0, 0]
+    z = (jnp.abs(wd) * mf00 + 2 * f) >> (qbits + 1)
+    return jnp.where(wd < 0, -z, z)
+
+
+def _luma_dc_dequant(z, qp):
+    f = jnp.einsum("ij,...jk,lk->...il", _H4, z, _H4)
+    ls = _V[qp % 6, 0, 0] * 16
+    hi = (f * ls) << jnp.maximum(qp // 6 - 6, 0)
+    shift = jnp.maximum(6 - qp // 6, 1)
+    lo = (f * ls + (1 << (shift - 1))) >> shift
+    return jnp.where(qp >= 36, hi, lo)
+
+
+def _chroma_dc_quant(wd, qp):
+    qbits = 15 + qp // 6
+    f = (1 << qbits) // 3
+    mf00 = _MF[qp % 6, 0, 0]
+    z = (jnp.abs(wd) * mf00 + 2 * f) >> (qbits + 1)
+    return jnp.where(wd < 0, -z, z)
+
+
+def _chroma_dc_dequant(z, qp):
+    f = jnp.einsum("ij,...jk,lk->...il", _H2, z, _H2)
+    ls = _V[qp % 6, 0, 0] * 16
+    return ((f * ls) << (qp // 6)) >> 5
+
+
+def _luma_mb_batch(src, pred, qp):
+    """src/pred: (n, 16, 16) int32 → (dc_lev (n,16), ac_lev (n,16,15),
+    recon (n,16,16))."""
+    n = src.shape[0]
+    resid = src - pred
+    blocks = resid.reshape(n, 4, 4, 4, 4).transpose(0, 1, 3, 2, 4).reshape(n, 16, 4, 4)
+    w = _fwd4(blocks)
+    dc = w[..., 0, 0].reshape(n, 4, 4)                      # [by, bx]
+    wd = jnp.einsum("ij,njk,lk->nil", _H4, dc, _H4) // 2
+    dc_lev = _zigzag(_luma_dc_quant(wd, qp))
+    z = _quant(w, qp, skip_dc=True)
+    ac_lev = _zigzag(z)[:, _ZSCAN, 1:]
+    # closed-loop recon from the signaled levels
+    dcr = _luma_dc_dequant(_inv_zigzag(dc_lev), qp)         # (n, 4, 4)
+    d = _dequant(z, qp)
+    d = d.at[..., 0, 0].set(dcr.reshape(n, 16))
+    r = (_inv4(d) + 32) >> 6
+    predb = pred.reshape(n, 4, 4, 4, 4).transpose(0, 1, 3, 2, 4).reshape(n, 16, 4, 4)
+    rec = jnp.clip(predb + r, 0, 255)
+    rec = rec.reshape(n, 4, 4, 4, 4).transpose(0, 1, 3, 2, 4).reshape(n, 16, 16)
+    return dc_lev, ac_lev, rec
+
+
+def _chroma_mb_batch(src, pred, qpc):
+    """src/pred: (n, 8, 8) int32 → (dc_lev (n,4), ac_lev (n,4,15), recon)."""
+    n = src.shape[0]
+    resid = src - pred
+    blocks = resid.reshape(n, 2, 4, 2, 4).transpose(0, 1, 3, 2, 4).reshape(n, 4, 4, 4)
+    w = _fwd4(blocks)
+    dc = w[..., 0, 0].reshape(n, 2, 2)
+    wd = jnp.einsum("ij,njk,lk->nil", _H2, dc, _H2)
+    dc_lev = _chroma_dc_quant(wd, qpc).reshape(n, 4)
+    z = _quant(w, qpc, skip_dc=True)
+    ac_lev = _zigzag(z)[..., 1:]
+    dcr = _chroma_dc_dequant(dc_lev.reshape(n, 2, 2), qpc)
+    d = _dequant(z, qpc)
+    d = d.at[..., 0, 0].set(dcr.reshape(n, 4))
+    r = (_inv4(d) + 32) >> 6
+    predb = pred.reshape(n, 2, 4, 2, 4).transpose(0, 1, 3, 2, 4).reshape(n, 4, 4, 4)
+    rec = jnp.clip(predb + r, 0, 255)
+    rec = rec.reshape(n, 2, 2, 4, 4).transpose(0, 1, 3, 2, 4).reshape(n, 8, 8)
+    return dc_lev, ac_lev, rec
+
+
+@functools.partial(jax.jit, static_argnames=("mbw", "mbh"))
+def _encode_intra(y, u, v, qp, *, mbw: int, mbh: int):
+    qp = qp.astype(jnp.int32)
+    qpc = _QPC[jnp.clip(qp, 0, 51)]
+    y = y.astype(jnp.int32)
+    u = u.astype(jnp.int32)
+    v = v.astype(jnp.int32)
+
+    # --- row 0: sequential over MBs (DC for MB0, horizontal after) ---
+    y_row0 = y[:16].reshape(16, mbw, 16).transpose(1, 0, 2)      # (mbw,16,16)
+    u_row0 = u[:8].reshape(8, mbw, 8).transpose(1, 0, 2)
+    v_row0 = v[:8].reshape(8, mbw, 8).transpose(1, 0, 2)
+
+    def row0_step(carry, x):
+        ly, lu, lv, idx = carry
+        sy, su, sv = x
+        pred_y = jnp.where(idx == 0, jnp.full((16, 16), 128, jnp.int32),
+                           jnp.tile(ly[:, None], (1, 16)))
+        pred_u = jnp.where(idx == 0, jnp.full((8, 8), 128, jnp.int32),
+                           jnp.tile(lu[:, None], (1, 8)))
+        pred_v = jnp.where(idx == 0, jnp.full((8, 8), 128, jnp.int32),
+                           jnp.tile(lv[:, None], (1, 8)))
+        ydc, yac, yrec = _luma_mb_batch(sy[None], pred_y[None], qp)
+        udc, uac, urec = _chroma_mb_batch(su[None], pred_u[None], qpc)
+        vdc, vac, vrec = _chroma_mb_batch(sv[None], pred_v[None], qpc)
+        carry = (yrec[0, :, -1], urec[0, :, -1], vrec[0, :, -1], idx + 1)
+        return carry, (ydc[0], yac[0], udc[0], uac[0], vdc[0], vac[0],
+                       yrec[0], urec[0], vrec[0])
+
+    init = (jnp.zeros(16, jnp.int32), jnp.zeros(8, jnp.int32),
+            jnp.zeros(8, jnp.int32), jnp.int32(0))
+    _, row0_out = jax.lax.scan(row0_step, init, (y_row0, u_row0, v_row0))
+    (r0_ydc, r0_yac, r0_udc, r0_uac, r0_vdc, r0_vac,
+     r0_yrec, r0_urec, r0_vrec) = row0_out
+    bottom_y = r0_yrec[:, -1, :].reshape(-1)                     # (W,)
+    bottom_u = r0_urec[:, -1, :].reshape(-1)
+    bottom_v = r0_vrec[:, -1, :].reshape(-1)
+
+    if mbh > 1:
+        # --- rows 1..mbh-1: scan over rows, vectorized across MBs ---
+        y_rows = y[16:].reshape(mbh - 1, 16, mbw, 16).transpose(0, 2, 1, 3)
+        u_rows = u[8:].reshape(mbh - 1, 8, mbw, 8).transpose(0, 2, 1, 3)
+        v_rows = v[8:].reshape(mbh - 1, 8, mbw, 8).transpose(0, 2, 1, 3)
+
+        def row_step(carry, x):
+            by, bu, bv = carry
+            sy, su, sv = x                                       # (mbw,16,16)
+            pred_y = jnp.broadcast_to(by.reshape(mbw, 1, 16), (mbw, 16, 16))
+            pred_u = jnp.broadcast_to(bu.reshape(mbw, 1, 8), (mbw, 8, 8))
+            pred_v = jnp.broadcast_to(bv.reshape(mbw, 1, 8), (mbw, 8, 8))
+            ydc, yac, yrec = _luma_mb_batch(sy, pred_y, qp)
+            udc, uac, urec = _chroma_mb_batch(su, pred_u, qpc)
+            vdc, vac, vrec = _chroma_mb_batch(sv, pred_v, qpc)
+            carry = (yrec[:, -1, :].reshape(-1), urec[:, -1, :].reshape(-1),
+                     vrec[:, -1, :].reshape(-1))
+            return carry, (ydc, yac, udc, uac, vdc, vac)
+
+        _, rows_out = jax.lax.scan(
+            row_step, (bottom_y, bottom_u, bottom_v), (y_rows, u_rows, v_rows))
+        ydc_r, yac_r, udc_r, uac_r, vdc_r, vac_r = rows_out
+        luma_dc = jnp.concatenate([r0_ydc[None], ydc_r]).reshape(-1, 16)
+        luma_ac = jnp.concatenate([r0_yac[None], yac_r]).reshape(-1, 16, 15)
+        u_dc = jnp.concatenate([r0_udc[None], udc_r]).reshape(-1, 4)
+        u_ac = jnp.concatenate([r0_uac[None], uac_r]).reshape(-1, 4, 15)
+        v_dc = jnp.concatenate([r0_vdc[None], vdc_r]).reshape(-1, 4)
+        v_ac = jnp.concatenate([r0_vac[None], vac_r]).reshape(-1, 4, 15)
+    else:
+        luma_dc, luma_ac = r0_ydc, r0_yac
+        u_dc, u_ac, v_dc, v_ac = r0_udc, r0_uac, r0_vdc, r0_vac
+
+    chroma_dc = jnp.stack([u_dc, v_dc], axis=1)                  # (nmb,2,4)
+    chroma_ac = jnp.stack([u_ac, v_ac], axis=1)                  # (nmb,2,4,15)
+    return luma_dc, luma_ac, chroma_dc, chroma_ac
+
+
+def encode_intra_jax(y: np.ndarray, u: np.ndarray, v: np.ndarray,
+                     qp: int) -> FrameLevels:
+    """Run the jitted intra compute and return host-side FrameLevels."""
+    mbh, mbw = y.shape[0] // 16, y.shape[1] // 16
+    luma_dc, luma_ac, chroma_dc, chroma_ac = _encode_intra(
+        jnp.asarray(y), jnp.asarray(u), jnp.asarray(v), jnp.asarray(qp),
+        mbw=mbw, mbh=mbh)
+    luma_mode, chroma_mode = _mode_policy(mbw, mbh)
+    return FrameLevels(
+        luma_mode=luma_mode,
+        chroma_mode=chroma_mode,
+        luma_dc=np.asarray(luma_dc),
+        luma_ac=np.asarray(luma_ac),
+        chroma_dc=np.asarray(chroma_dc),
+        chroma_ac=np.asarray(chroma_ac),
+    )
+
+
+def build_intra_encoder(y_shape: tuple[int, int], qp: int):
+    """Encoder-facing factory: returns fn(y, u, v) -> FrameLevels."""
+    def fn(y, u, v):
+        return encode_intra_jax(y, u, v, qp)
+    return fn

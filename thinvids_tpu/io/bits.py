@@ -72,6 +72,17 @@ class BitWriter:
             )
         return bytes(self._buf)
 
+    def getvalue_unaligned(self) -> tuple[bytes, int]:
+        """(zero-padded bytes, true bit length) — for splicing into another
+        bit writer (e.g. the native packer continues after the header)."""
+        total_bits = self.bit_length
+        if self._nbits:
+            pad = 8 - self._nbits
+            data = bytes(self._buf) + bytes([(self._acc << pad) & 0xFF])
+        else:
+            data = bytes(self._buf)
+        return data, total_bits
+
 
 class BitReader:
     """MSB-first bit reader matching :class:`BitWriter`."""

@@ -1,0 +1,140 @@
+"""Native (C++) hot paths, built on demand and loaded via ctypes.
+
+The reference's native layer was external ffmpeg binaries; here the
+sequential entropy pack — the one part of the encoder that cannot be a
+TPU kernel (bit-serial, data-dependent) — runs as compiled C++ while the
+blockwise math stays on the TPU. Falls back to the pure-Python packer
+when no compiler is available (same output bits, tested identical).
+
+Build artifacts go to native/_build/ (gitignored).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "cavlc_pack.cpp")
+_BUILD_DIR = os.path.join(_DIR, "_build")
+_SO = os.path.join(_BUILD_DIR, "cavlc_pack.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed: str | None = None
+
+
+def _marshal_tables():
+    from ..codecs.h264 import tables as t
+
+    coeff = np.zeros((4, 17, 4, 2), np.int32)
+    for ctx in range(4):
+        for (tc, t1), (length, bits) in t.COEFF_TOKEN[ctx].items():
+            coeff[ctx, tc, t1] = (length, bits)
+    chroma = np.zeros((5, 4, 2), np.int32)
+    for (tc, t1), (length, bits) in t.CHROMA_DC_COEFF_TOKEN.items():
+        chroma[tc, t1] = (length, bits)
+    tz = np.zeros((16, 16, 2), np.int32)
+    for tc, codes in t.TOTAL_ZEROS_4x4.items():
+        for z, (length, bits) in enumerate(codes):
+            tz[tc, z] = (length, bits)
+    tzc = np.zeros((4, 4, 2), np.int32)
+    for tc, codes in t.TOTAL_ZEROS_CHROMA_DC.items():
+        for z, (length, bits) in enumerate(codes):
+            tzc[tc, z] = (length, bits)
+    rb = np.zeros((8, 15, 2), np.int32)
+    for zl, codes in t.RUN_BEFORE.items():
+        for r, (length, bits) in enumerate(codes):
+            rb[zl, r] = (length, bits)
+    return coeff, chroma, tz, tzc, rb
+
+
+def _build_and_load() -> ctypes.CDLL:
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_failed is not None:
+            raise RuntimeError(_load_failed)
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                os.makedirs(_BUILD_DIR, exist_ok=True)
+                tmp = _SO + ".tmp"
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                     _SRC, "-o", tmp],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, _SO)
+            lib = ctypes.CDLL(_SO)
+        except (OSError, subprocess.SubprocessError) as exc:
+            _load_failed = f"native packer unavailable: {exc}"
+            raise RuntimeError(_load_failed) from exc
+
+        lib.cavlc_init_tables.argtypes = [ctypes.c_void_p] * 5
+        lib.cavlc_pack_islice.restype = ctypes.c_int64
+        lib.cavlc_pack_islice.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32,            # header bytes, bitlen
+            ctypes.c_void_p, ctypes.c_void_p,           # modes
+            ctypes.c_void_p, ctypes.c_void_p,           # luma dc/ac
+            ctypes.c_void_p, ctypes.c_void_p,           # chroma dc/ac
+            ctypes.c_int32, ctypes.c_int32,             # mbw, mbh
+            ctypes.c_void_p, ctypes.c_int64,            # out, cap
+        ]
+        arrs = _marshal_tables()
+        lib._table_refs = arrs  # keep alive
+        lib.cavlc_init_tables(*(a.ctypes.data for a in arrs))
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    try:
+        _build_and_load()
+        return True
+    except RuntimeError:
+        return False
+
+
+def pack_islice(header_bytes: bytes, header_bit_len: int,
+                luma_mode: np.ndarray, chroma_mode: np.ndarray,
+                luma_dc: np.ndarray, luma_ac: np.ndarray,
+                chroma_dc: np.ndarray, chroma_ac: np.ndarray,
+                mbw: int, mbh: int) -> bytes:
+    """Pack one I-slice (header bits + MB layer) and return the EBSP payload."""
+    lib = _build_and_load()
+    nmb = mbw * mbh
+
+    def prep(a, shape):
+        a = np.ascontiguousarray(a, np.int32)
+        if a.shape != shape:
+            raise ValueError(f"bad array shape {a.shape}, want {shape}")
+        return a
+
+    luma_mode = prep(luma_mode, (nmb,))
+    chroma_mode = prep(chroma_mode, (nmb,))
+    luma_dc = prep(luma_dc, (nmb, 16))
+    luma_ac = prep(luma_ac, (nmb, 16, 15))
+    chroma_dc = prep(chroma_dc, (nmb, 2, 4))
+    chroma_ac = prep(chroma_ac, (nmb, 2, 4, 15))
+
+    # CAVLC worst case ≈ 28 bits/coeff × 384 coeffs ≈ 1.4 KB per MB (plus
+    # emulation-prevention expansion); 4 KB/MB is a safe ceiling.
+    cap = max(8192, nmb * 4096)
+    out = np.empty(cap, np.uint8)
+    hdr = np.frombuffer(header_bytes, np.uint8)
+    n = lib.cavlc_pack_islice(
+        hdr.ctypes.data, header_bit_len,
+        luma_mode.ctypes.data, chroma_mode.ctypes.data,
+        luma_dc.ctypes.data, luma_ac.ctypes.data,
+        chroma_dc.ctypes.data, chroma_ac.ctypes.data,
+        mbw, mbh, out.ctypes.data, cap)
+    if n == -2:
+        raise RuntimeError("native packer output buffer overflow")
+    if n < 0:
+        raise RuntimeError(f"native packer failed ({n})")
+    return out[:n].tobytes()

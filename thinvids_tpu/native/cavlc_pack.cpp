@@ -1,0 +1,263 @@
+// Native CAVLC slice packer — the sequential hot path of the encoder.
+//
+// The TPU produces quantized level arrays (codecs/h264/jaxcore.py); this
+// translation unit turns them into a conformant I-slice EBSP payload at
+// native speed. It is the C++ analog of codecs/h264/encoder.pack_slice and
+// is tested bit-for-bit against it. VLC tables are NOT duplicated here —
+// Python passes the arrays from codecs/h264/tables.py via cavlc_init_tables
+// so there is a single source of truth.
+//
+// Built at first use by thinvids_tpu/native/__init__.py (g++ -O2 -shared).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+// --- shared VLC tables, set once from Python -------------------------------
+// coeff_token[ctx][tc][t1] -> (len, bits); len 0 = invalid combo
+static int32_t g_coeff_token[4][17][4][2];
+static int32_t g_chroma_dc_token[5][4][2];
+static int32_t g_total_zeros[16][16][2];    // [total_coeff][total_zeros]
+static int32_t g_tz_chroma[4][4][2];        // [total_coeff][total_zeros]
+static int32_t g_run_before[8][15][2];      // [min(zeros_left,7)][run]
+static bool g_tables_ready = false;
+
+struct BitWriter {
+  std::vector<uint8_t> buf;
+  uint64_t acc = 0;
+  int nbits = 0;
+
+  void write(uint32_t value, int n) {
+    acc = (acc << n) | value;
+    nbits += n;
+    while (nbits >= 8) {
+      nbits -= 8;
+      buf.push_back(static_cast<uint8_t>((acc >> nbits) & 0xFF));
+    }
+    acc &= (1ULL << nbits) - 1;
+  }
+  void ue(uint32_t v) {
+    uint32_t code = v + 1;
+    int n = 32 - __builtin_clz(code);
+    write(0, n - 1);
+    write(code, n);
+  }
+  void se(int32_t v) { ue(v > 0 ? 2 * (uint32_t)v - 1 : (uint32_t)(-2 * v)); }
+  void trailing() {
+    write(1, 1);
+    if (nbits % 8) write(0, 8 - (nbits % 8));
+  }
+};
+
+// Returns total_coeff; writes the residual block. coeffs: zig-zag order.
+static int encode_residual(BitWriter& bw, const int32_t* coeffs, int n, int nc) {
+  int positions[16];
+  int total = 0;
+  for (int i = 0; i < n; i++)
+    if (coeffs[i]) positions[total++] = i;
+
+  int trailing = 0;
+  for (int k = total - 1; k >= 0 && trailing < 3; k--) {
+    int32_t c = coeffs[positions[k]];
+    if (c != 1 && c != -1) break;
+    trailing++;
+  }
+
+  const int32_t* tok;
+  if (nc == -1) {
+    tok = g_chroma_dc_token[total][trailing];
+  } else {
+    int ctx = nc < 2 ? 0 : nc < 4 ? 1 : nc < 8 ? 2 : 3;
+    tok = g_coeff_token[ctx][total][trailing];
+  }
+  bw.write((uint32_t)tok[1], tok[0]);
+  if (total == 0) return 0;
+
+  for (int k = total - 1; k >= total - trailing; k--)
+    bw.write(coeffs[positions[k]] < 0 ? 1u : 0u, 1);
+
+  int suffix_len = (total > 10 && trailing < 3) ? 1 : 0;
+  bool first = true;
+  for (int k = total - trailing - 1; k >= 0; k--) {
+    int32_t level = coeffs[positions[k]];
+    int32_t mag = level < 0 ? -level : level;
+    uint32_t level_code = (uint32_t)(mag - 1) * 2 + (level < 0 ? 1 : 0);
+    if (first && trailing < 3) level_code -= 2;
+    first = false;
+    if (suffix_len == 0) {
+      if (level_code < 14) {
+        bw.write(1, level_code + 1);
+      } else if (level_code < 30) {
+        bw.write(1, 15);
+        bw.write(level_code - 14, 4);
+      } else {
+        bw.write(1, 16);
+        bw.write(level_code - 30, 12);
+      }
+    } else {
+      uint32_t prefix = level_code >> suffix_len;
+      if (prefix < 15) {
+        bw.write(1, prefix + 1);
+        bw.write(level_code & ((1u << suffix_len) - 1), suffix_len);
+      } else {
+        bw.write(1, 16);
+        bw.write(level_code - (15u << suffix_len), 12);
+      }
+    }
+    if (suffix_len == 0) suffix_len = 1;
+    if (mag > (3 << (suffix_len - 1)) && suffix_len < 6) suffix_len++;
+  }
+
+  int total_zeros = positions[total - 1] + 1 - total;
+  if (total < n) {
+    const int32_t* tz = (nc == -1) ? g_tz_chroma[total][total_zeros]
+                                   : g_total_zeros[total][total_zeros];
+    bw.write((uint32_t)tz[1], tz[0]);
+  }
+  int zeros_left = total_zeros;
+  for (int k = total - 1; k >= 1 && zeros_left > 0; k--) {
+    int run = positions[k] - positions[k - 1] - 1;
+    const int32_t* rb = g_run_before[zeros_left < 7 ? zeros_left : 7][run];
+    bw.write((uint32_t)rb[1], rb[0]);
+    zeros_left -= run;
+  }
+  return total;
+}
+
+}  // namespace
+
+extern "C" {
+
+void cavlc_init_tables(const int32_t* coeff_token, const int32_t* chroma_dc,
+                       const int32_t* total_zeros, const int32_t* tz_chroma,
+                       const int32_t* run_before) {
+  std::memcpy(g_coeff_token, coeff_token, sizeof(g_coeff_token));
+  std::memcpy(g_chroma_dc_token, chroma_dc, sizeof(g_chroma_dc_token));
+  std::memcpy(g_total_zeros, total_zeros, sizeof(g_total_zeros));
+  std::memcpy(g_tz_chroma, tz_chroma, sizeof(g_tz_chroma));
+  std::memcpy(g_run_before, run_before, sizeof(g_run_before));
+  g_tables_ready = true;
+}
+
+// Packs slice-header bits + all MB data + rbsp trailing, applies emulation
+// prevention. Returns EBSP byte length, or -1 on error / -2 if out_cap is
+// too small.
+int64_t cavlc_pack_islice(
+    const uint8_t* header_bytes, int32_t header_bit_len,
+    const int32_t* luma_mode, const int32_t* chroma_mode,
+    const int32_t* luma_dc,    // nmb*16
+    const int32_t* luma_ac,    // nmb*16*15
+    const int32_t* chroma_dc,  // nmb*2*4
+    const int32_t* chroma_ac,  // nmb*2*4*15
+    int32_t mbw, int32_t mbh, uint8_t* out, int64_t out_cap) {
+  if (!g_tables_ready || mbw <= 0 || mbh <= 0) return -1;
+  // z-scan order of 4x4 luma blocks within a MB: (bx, by)
+  static const int BX[16] = {0, 1, 0, 1, 2, 3, 2, 3, 0, 1, 0, 1, 2, 3, 2, 3};
+  static const int BY[16] = {0, 0, 1, 1, 0, 0, 1, 1, 2, 2, 3, 3, 2, 2, 3, 3};
+  static const int CBX[4] = {0, 1, 0, 1};
+  static const int CBY[4] = {0, 0, 1, 1};
+
+  BitWriter bw;
+  bw.buf.reserve((size_t)mbw * mbh * 64);
+  // splice in the slice header bit string
+  for (int i = 0; i < header_bit_len / 8; i++) bw.write(header_bytes[i], 8);
+  if (int rem = header_bit_len % 8)
+    bw.write(header_bytes[header_bit_len / 8] >> (8 - rem), rem);
+
+  const int lw = 4 * mbw, lh = 4 * mbh;
+  const int cw = 2 * mbw, ch = 2 * mbh;
+  std::vector<int32_t> lcnt((size_t)lw * lh, 0);
+  std::vector<int32_t> ccnt((size_t)2 * cw * ch, 0);
+
+  auto luma_nc = [&](int gy, int gx) {
+    bool a = gx > 0, b = gy > 0;
+    int na = a ? lcnt[(size_t)gy * lw + gx - 1] : 0;
+    int nb = b ? lcnt[(size_t)(gy - 1) * lw + gx] : 0;
+    if (a && b) return (na + nb + 1) >> 1;
+    if (a) return na;
+    if (b) return nb;
+    return 0;
+  };
+  auto chroma_nc = [&](int ci, int gy, int gx) {
+    bool a = gx > 0, b = gy > 0;
+    int na = a ? ccnt[((size_t)ci * ch + gy) * cw + gx - 1] : 0;
+    int nb = b ? ccnt[((size_t)ci * ch + gy - 1) * cw + gx] : 0;
+    if (a && b) return (na + nb + 1) >> 1;
+    if (a) return na;
+    if (b) return nb;
+    return 0;
+  };
+
+  for (int my = 0; my < mbh; my++) {
+    for (int mx = 0; mx < mbw; mx++) {
+      const int mi = my * mbw + mx;
+      const int32_t* lac = luma_ac + (size_t)mi * 16 * 15;
+      const int32_t* cac = chroma_ac + (size_t)mi * 2 * 4 * 15;
+      const int32_t* cdc = chroma_dc + (size_t)mi * 2 * 4;
+
+      int cbp_luma = 0;
+      for (int i = 0; i < 16 * 15 && !cbp_luma; i++)
+        if (lac[i]) cbp_luma = 15;
+      int cbp_chroma = 0;
+      for (int i = 0; i < 2 * 4 * 15 && cbp_chroma < 2; i++)
+        if (cac[i]) cbp_chroma = 2;
+      if (cbp_chroma == 0)
+        for (int i = 0; i < 8 && !cbp_chroma; i++)
+          if (cdc[i]) cbp_chroma = 1;
+
+      int mb_type = 1 + luma_mode[mi] + 4 * cbp_chroma + (cbp_luma ? 12 : 0);
+      bw.ue((uint32_t)mb_type);
+      bw.ue((uint32_t)chroma_mode[mi]);
+      bw.se(0);  // mb_qp_delta
+
+      const int by0 = 4 * my, bx0 = 4 * mx;
+      encode_residual(bw, luma_dc + (size_t)mi * 16, 16, luma_nc(by0, bx0));
+
+      for (int bi = 0; bi < 16; bi++) {
+        int gy = by0 + BY[bi], gx = bx0 + BX[bi];
+        if (cbp_luma) {
+          int tc = encode_residual(bw, lac + (size_t)bi * 15, 15, luma_nc(gy, gx));
+          lcnt[(size_t)gy * lw + gx] = tc;
+        } else {
+          lcnt[(size_t)gy * lw + gx] = 0;
+        }
+      }
+      if (cbp_chroma > 0)
+        for (int ci = 0; ci < 2; ci++)
+          encode_residual(bw, cdc + (size_t)ci * 4, 4, -1);
+      const int cy0 = 2 * my, cx0 = 2 * mx;
+      for (int ci = 0; ci < 2; ci++) {
+        for (int bi = 0; bi < 4; bi++) {
+          int gy = cy0 + CBY[bi], gx = cx0 + CBX[bi];
+          if (cbp_chroma == 2) {
+            int tc = encode_residual(bw, cac + ((size_t)ci * 4 + bi) * 15, 15,
+                                     chroma_nc(ci, gy, gx));
+            ccnt[((size_t)ci * ch + gy) * cw + gx] = tc;
+          } else {
+            ccnt[((size_t)ci * ch + gy) * cw + gx] = 0;
+          }
+        }
+      }
+    }
+  }
+  bw.trailing();
+
+  // Emulation prevention: rbsp -> ebsp into `out`.
+  int64_t o = 0;
+  int zeros = 0;
+  for (uint8_t b : bw.buf) {
+    if (zeros >= 2 && b <= 3) {
+      if (o >= out_cap) return -2;
+      out[o++] = 3;
+      zeros = 0;
+    }
+    if (o >= out_cap) return -2;
+    out[o++] = b;
+    zeros = (b == 0) ? zeros + 1 : 0;
+  }
+  return o;
+}
+
+}  // extern "C"

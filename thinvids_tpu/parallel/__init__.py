@@ -1,0 +1,13 @@
+"""Sequence (GOP) parallelism over a TPU device mesh.
+
+The reference shards the video timeline into ~10 MB file segments dispatched
+to worker nodes over a task queue (/root/reference/worker/tasks.py:597-609,
+977-1052); here the timeline is sharded at closed-GOP boundaries across the
+devices of a `jax.sharding.Mesh` with `shard_map`, and encoded segments are
+re-assembled in index order (the stitcher analog, tasks.py:2047-2069).
+"""
+
+from .planner import plan_segments
+from .dispatch import GopShardEncoder, encode_clip_sharded
+
+__all__ = ["plan_segments", "GopShardEncoder", "encode_clip_sharded"]
