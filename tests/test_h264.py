@@ -228,3 +228,57 @@ class TestConformanceOracle:
         stream = H264Encoder(meta, qp=30).encode_frame(frame)
         (oy, ou, ov) = oracle.decode_h264(stream)[0]
         assert np.array_equal(oy, np.full((32, 32), 128))
+
+
+class TestGuards:
+    def test_odd_dimensions_rejected(self):
+        with pytest.raises(ValueError, match="odd dimensions"):
+            SPS(width=33, height=48).to_rbsp()
+        with pytest.raises(ValueError, match="odd dimensions"):
+            SPS(width=64, height=47).to_rbsp()
+
+    def test_non_420_input_rejected(self):
+        meta = VideoMeta(width=32, height=32)
+        enc = H264Encoder(meta, qp=27)
+        f422 = Frame(
+            y=np.zeros((32, 32), np.uint8),
+            u=np.zeros((32, 16), np.uint8),   # full-height chroma: 4:2:2
+            v=np.zeros((32, 16), np.uint8),
+        )
+        with pytest.raises(ValueError, match="4:2:0"):
+            enc.encode_frame(f422)
+
+    def test_malformed_chroma_plane_rejected(self):
+        f = Frame(
+            y=np.zeros((64, 64), np.uint8),
+            u=np.zeros((16, 16), np.uint8),   # neither 32 nor 64
+            v=np.zeros((16, 16), np.uint8),
+        )
+        with pytest.raises(ValueError, match="chroma"):
+            f.padded(16)
+
+    def test_native_escape_overflow_matches_python(self):
+        # A level too large for the baseline CAVLC 12-bit escape must
+        # raise in BOTH packers (the native path previously emitted a
+        # corrupt stream silently).
+        from thinvids_tpu import native
+        from thinvids_tpu.codecs.h264.encoder import FrameLevels, pack_slice
+
+        if not native.available():
+            pytest.skip("no compiler")
+        nmb = 1
+        levels = FrameLevels(
+            luma_mode=np.zeros(nmb, np.int32),
+            chroma_mode=np.zeros(nmb, np.int32),
+            luma_dc=np.zeros((nmb, 16), np.int32),
+            luma_ac=np.zeros((nmb, 16, 15), np.int32),
+            chroma_dc=np.zeros((nmb, 2, 4), np.int32),
+            chroma_ac=np.zeros((nmb, 2, 4, 15), np.int32),
+        )
+        levels.luma_ac[0, 0, 0] = 3000   # level_code far beyond 12-bit escape
+        sps = SPS(width=16, height=16)
+        pps = PPS(init_qp=27)
+        with pytest.raises(ValueError, match="too large"):
+            pack_slice(levels, 1, 1, sps, pps, 27, native=True)
+        with pytest.raises(ValueError, match="too large"):
+            pack_slice(levels, 1, 1, sps, pps, 27, native=False)

@@ -1,0 +1,159 @@
+"""Multi-device GOP sharding tests on the 8-device virtual CPU mesh.
+
+These are the "fake cluster" tests (SURVEY.md §4): `shard_map` over a real
+`jax.sharding.Mesh` of 8 virtual CPU devices, asserting the sharded encode
+is bit-identical to the single-device path.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from thinvids_tpu.core.types import Frame, VideoMeta, concat_segments
+from thinvids_tpu.codecs.h264.encoder import H264Encoder
+from thinvids_tpu.parallel.dispatch import (
+    GopShardEncoder,
+    default_mesh,
+    encode_clip_sharded,
+)
+from thinvids_tpu.parallel.planner import plan_segments
+
+
+def _make_frames(n, w=64, h=48, seed=0):
+    rng = np.random.default_rng(seed)
+    frames = []
+    for _ in range(n):
+        frames.append(Frame(
+            y=rng.integers(0, 256, (h, w), dtype=np.uint8),
+            u=rng.integers(0, 256, (h // 2, w // 2), dtype=np.uint8),
+            v=rng.integers(0, 256, (h // 2, w // 2), dtype=np.uint8),
+        ))
+    return frames
+
+
+def _reference_stream(frames, meta, qp, gop_frames, num_devices,
+                      max_segments=200):
+    """Single-device encode emitting SPS/PPS at every GOP head, matching
+    the sharded layout (idr_pic_id = global frame index)."""
+    plan = plan_segments(len(frames), gop_frames, num_devices, max_segments)
+    enc = H264Encoder(meta, qp=qp, use_jax=False)
+    out = []
+    for gop in plan.gops:
+        for fi, i in enumerate(range(gop.start_frame, gop.end_frame)):
+            out.append(enc.encode_frame(frames[i], idr_pic_id=i,
+                                        with_headers=(fi == 0)))
+    return b"".join(out)
+
+
+class TestPlanner:
+    def test_covers_every_frame_once(self):
+        plan = plan_segments(100, 10, 8)
+        assert plan.gops[0].start_frame == 0
+        for a, b in zip(plan.gops, plan.gops[1:]):
+            assert b.start_frame == a.end_frame
+        assert plan.gops[-1].end_frame == 100
+
+    def test_rounds_up_to_device_multiple(self):
+        plan = plan_segments(320, 32, 8)
+        # ceil(320/32)=10 -> rounded to 16 (multiple of 8)
+        assert plan.num_gops == 16
+        assert plan.waves == 2
+
+    def test_no_rounding_when_gops_would_be_empty(self):
+        # 5 frames over 8 devices: rounding to 8 would need >= 8 frames.
+        plan = plan_segments(5, 2, 8)
+        assert plan.num_gops <= 5
+        assert all(g.num_frames >= 1 for g in plan.gops)
+
+    def test_max_segments_cap(self):
+        plan = plan_segments(10_000, 1, 8, max_segments=200)
+        assert plan.num_gops == 200
+
+    def test_n_capped_by_num_frames(self):
+        plan = plan_segments(3, 1, 8)
+        assert plan.num_gops == 3
+        assert [g.num_frames for g in plan.gops] == [1, 1, 1]
+
+    def test_remainder_distribution(self):
+        plan = plan_segments(10, 3, 4)
+        sizes = [g.num_frames for g in plan.gops]
+        assert sum(sizes) == 10
+        assert max(sizes) - min(sizes) <= 1
+
+    def test_invalid_args(self):
+        with pytest.raises(ValueError):
+            plan_segments(0, 8, 8)
+        with pytest.raises(ValueError):
+            plan_segments(10, 0, 8)
+        with pytest.raises(ValueError):
+            plan_segments(10, 8, 0)
+
+
+class TestShardedDispatch:
+    def test_mesh_has_8_virtual_devices(self):
+        assert len(jax.devices()) == 8
+
+    def test_sharded_bit_identical_to_single_device(self):
+        frames = _make_frames(16)
+        meta = VideoMeta(width=64, height=48, fps_num=30, fps_den=1,
+                         num_frames=16)
+        got = encode_clip_sharded(frames, meta, qp=27, gop_frames=2)
+        want = _reference_stream(frames, meta, 27, 2, len(jax.devices()))
+        assert got == want
+
+    def test_sharded_uneven_wave(self):
+        # 10 frames, gop 3 → plan caps/rounds; last wave is partial.
+        frames = _make_frames(10, seed=3)
+        meta = VideoMeta(width=64, height=48, num_frames=10)
+        mesh = default_mesh()
+        enc = GopShardEncoder(meta, qp=30, mesh=mesh, gop_frames=3)
+        segments = enc.encode(frames)
+        got = concat_segments(segments)
+        plan = enc.plan(len(frames))
+        want = _reference_stream(frames, meta, 30, 3, len(jax.devices()))
+        assert len(segments) == plan.num_gops
+        assert got == want
+
+    def test_sparse_and_dense_transfer_paths_agree(self):
+        # Smooth frames take the sparse-packed transfer; noisy frames hit
+        # the dense fallback. Both must equal the single-device stream.
+        meta = VideoMeta(width=64, height=48, num_frames=8)
+        yy, xx = np.mgrid[0:48, 0:64]
+        smooth = [Frame(
+            y=((xx + yy + 7 * i) % 256).astype(np.uint8),
+            u=np.full((24, 32), 100 + i, np.uint8),
+            v=np.full((24, 32), 140 - i, np.uint8),
+        ) for i in range(8)]
+        got = encode_clip_sharded(smooth, meta, qp=30, gop_frames=2)
+        want = _reference_stream(smooth, meta, 30, 2, len(jax.devices()))
+        assert got == want
+
+    def test_sparse_pack_roundtrip(self):
+        from thinvids_tpu.codecs.h264 import jaxcore
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        L = 3840
+        flat = np.zeros(L, np.int32)
+        nz = rng.choice(L, size=L // 8, replace=False)
+        flat[nz] = rng.integers(-127, 128, size=L // 8)
+        flat[nz[0]] = 0   # make one chosen slot zero again
+        flat[nz[1]] = 400     # escape: exceeds int8
+        flat[nz[2]] = -1900   # escape, negative
+        nnz, n_esc, bitmap, vals, esc_pos, esc_val = jax.device_get(
+            jaxcore._sparse_pack(jnp.asarray(flat)))
+        assert int(n_esc) == 2
+        assert jaxcore.sparse_fits(nnz, n_esc, L)
+        out = jaxcore._sparse_unpack(int(nnz), int(n_esc), bitmap, vals,
+                                     esc_pos, esc_val, L)
+        np.testing.assert_array_equal(out, flat)
+
+    def test_sharded_decodes_via_own_decoder(self):
+        from thinvids_tpu.codecs.h264.decoder import decode_annexb
+
+        frames = _make_frames(8, seed=7)
+        meta = VideoMeta(width=64, height=48, num_frames=8)
+        stream = encode_clip_sharded(frames, meta, qp=27, gop_frames=2)
+        decoded = decode_annexb(stream)
+        assert len(decoded.frames) == 8

@@ -261,9 +261,12 @@ class H264Encoder:
     """Stateful per-job encoder: sequence headers + frame encode.
 
     v1 scope: intra-only (every frame IDR), 4:2:0, fixed qp, CAVLC.
+
+    The jitted JAX compute path is the default engine (TPU-first); pass
+    `use_jax=False` for the numpy reference implementation.
     """
 
-    def __init__(self, meta: VideoMeta, qp: int = 27, use_jax: bool = False):
+    def __init__(self, meta: VideoMeta, qp: int = 27, use_jax: bool = True):
         self.meta = meta
         self.qp = qp
         self.use_jax = use_jax
@@ -285,6 +288,14 @@ class H264Encoder:
 
     def encode_frame(self, frame: Frame, frame_num: int = 0,
                      idr_pic_id: int = 0, with_headers: bool = True) -> bytes:
+        from ...core.types import ChromaFormat
+
+        if frame.chroma is not ChromaFormat.YUV420:
+            # The MB geometry below hard-assumes 4:2:0 (8x8 chroma per MB);
+            # feeding 4:2:2/4:4:4 would silently mis-encode.
+            raise ValueError(
+                f"H264Encoder supports only 4:2:0 input, got "
+                f"{frame.chroma.name}; convert before encoding")
         padded = frame.padded(16)
         levels = self._compute(padded.y, padded.u, padded.v)
         mbh, mbw = padded.y.shape[0] // 16, padded.y.shape[1] // 16
@@ -297,7 +308,7 @@ class H264Encoder:
 
 
 def encode_frames(frames: list[Frame], meta: VideoMeta, qp: int = 27,
-                  use_jax: bool = False) -> bytes:
+                  use_jax: bool = True) -> bytes:
     """Encode a closed sequence of frames to one Annex-B byte stream."""
     enc = H264Encoder(meta, qp=qp, use_jax=use_jax)
     out = []

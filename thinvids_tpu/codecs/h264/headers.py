@@ -33,6 +33,16 @@ class SPS:
     fps_num: int = 30
     fps_den: int = 1
 
+    def __post_init__(self):
+        if self.width % 2 or self.height % 2:
+            # 4:2:0 frame cropping offsets are in 2-luma-pixel units, so
+            # an odd display dimension cannot be represented — callers
+            # must pre-scale to even dimensions. Validated here (not in
+            # to_rbsp) so encoders fail fast at construction.
+            raise ValueError(
+                f"odd dimensions {self.width}x{self.height} are not "
+                "representable with 4:2:0 frame cropping")
+
     @property
     def mb_width(self) -> int:
         return (self.width + 15) // 16

@@ -126,10 +126,28 @@ class Frame:
 
     def _chroma_divisors(self) -> tuple[int, int]:
         """(horizontal, vertical) divisors inferred from u-plane shape via
-        per-axis ceil-division ratios (robust to odd source dimensions)."""
+        per-axis ceil-division ratios (robust to odd source dimensions).
+
+        Each chroma axis must be exactly ceil(luma/2) or exactly luma —
+        anything else is a malformed plane, not a subsampling format."""
         ch, cw = self.u.shape
-        hdiv = 2 if cw == (self.width + 1) // 2 else 1
-        vdiv = 2 if ch == (self.height + 1) // 2 else 1
+        if cw == (self.width + 1) // 2:
+            hdiv = 2
+        elif cw == self.width:
+            hdiv = 1
+        else:
+            raise ValueError(
+                f"chroma width {cw} matches neither {self.width} (4:4:4) "
+                f"nor {(self.width + 1) // 2} (4:2:x) for luma width "
+                f"{self.width}")
+        if ch == (self.height + 1) // 2:
+            vdiv = 2
+        elif ch == self.height:
+            vdiv = 1
+        else:
+            raise ValueError(
+                f"chroma height {ch} matches neither {self.height} nor "
+                f"{(self.height + 1) // 2} for luma height {self.height}")
         if (hdiv, vdiv) == (1, 2):
             raise ValueError("4:4:0 chroma layout is not supported")
         return hdiv, vdiv

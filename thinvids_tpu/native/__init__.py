@@ -135,6 +135,8 @@ def pack_islice(header_bytes: bytes, header_bit_len: int,
         mbw, mbh, out.ctypes.data, cap)
     if n == -2:
         raise RuntimeError("native packer output buffer overflow")
+    if n == -3:
+        raise ValueError("level too large for baseline CAVLC")
     if n < 0:
         raise RuntimeError(f"native packer failed ({n})")
     return out[:n].tobytes()

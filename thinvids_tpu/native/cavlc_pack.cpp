@@ -93,6 +93,7 @@ static int encode_residual(BitWriter& bw, const int32_t* coeffs, int n, int nc) 
         bw.write(1, 15);
         bw.write(level_code - 14, 4);
       } else {
+        if (level_code - 30 >= (1u << 12)) return -3;  // exceeds baseline
         bw.write(1, 16);
         bw.write(level_code - 30, 12);
       }
@@ -102,6 +103,7 @@ static int encode_residual(BitWriter& bw, const int32_t* coeffs, int n, int nc) 
         bw.write(1, prefix + 1);
         bw.write(level_code & ((1u << suffix_len) - 1), suffix_len);
       } else {
+        if (level_code - (15u << suffix_len) >= (1u << 12)) return -3;
         bw.write(1, 16);
         bw.write(level_code - (15u << suffix_len), 12);
       }
@@ -213,12 +215,15 @@ int64_t cavlc_pack_islice(
       bw.se(0);  // mb_qp_delta
 
       const int by0 = 4 * my, bx0 = 4 * mx;
-      encode_residual(bw, luma_dc + (size_t)mi * 16, 16, luma_nc(by0, bx0));
+      if (encode_residual(bw, luma_dc + (size_t)mi * 16, 16,
+                          luma_nc(by0, bx0)) < 0)
+        return -3;
 
       for (int bi = 0; bi < 16; bi++) {
         int gy = by0 + BY[bi], gx = bx0 + BX[bi];
         if (cbp_luma) {
           int tc = encode_residual(bw, lac + (size_t)bi * 15, 15, luma_nc(gy, gx));
+          if (tc < 0) return -3;
           lcnt[(size_t)gy * lw + gx] = tc;
         } else {
           lcnt[(size_t)gy * lw + gx] = 0;
@@ -226,7 +231,8 @@ int64_t cavlc_pack_islice(
       }
       if (cbp_chroma > 0)
         for (int ci = 0; ci < 2; ci++)
-          encode_residual(bw, cdc + (size_t)ci * 4, 4, -1);
+          if (encode_residual(bw, cdc + (size_t)ci * 4, 4, -1) < 0)
+            return -3;
       const int cy0 = 2 * my, cx0 = 2 * mx;
       for (int ci = 0; ci < 2; ci++) {
         for (int bi = 0; bi < 4; bi++) {
@@ -234,6 +240,7 @@ int64_t cavlc_pack_islice(
           if (cbp_chroma == 2) {
             int tc = encode_residual(bw, cac + ((size_t)ci * 4 + bi) * 15, 15,
                                      chroma_nc(ci, gy, gx));
+            if (tc < 0) return -3;
             ccnt[((size_t)ci * ch + gy) * cw + gx] = tc;
           } else {
             ccnt[((size_t)ci * ch + gy) * cw + gx] = 0;

@@ -6,8 +6,9 @@ tasks.py:1167-1281); here a wave of GOPs is one SPMD program over the mesh:
 frames live HBM-resident per device, the jitted intra compute runs a
 sequential `lax.map` over the GOP's frames (the carry will hold reference
 frames once P-frames land), and the quantized levels return to host for
-entropy packing. Encoded segments concat in index order — bit-identical to
-a single-device encode (tested).
+entropy packing. Encoded segments concat in index order; bit-identity with
+the single-device encode is asserted by tests/test_parallel.py on an
+8-device virtual mesh.
 """
 
 from __future__ import annotations
@@ -21,7 +22,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.types import EncodedSegment, Frame, GopSpec, SegmentPlan, VideoMeta
-from ..codecs.h264.encoder import FrameLevels, _mode_policy, pack_slice
+from ..codecs.h264.encoder import pack_slice
 from ..codecs.h264.headers import PPS, SPS
 from ..codecs.h264 import jaxcore
 from .planner import plan_segments
@@ -32,26 +33,60 @@ def default_mesh(devices=None) -> Mesh:
     return Mesh(np.array(devices), ("gop",))
 
 
+def _flat_levels(y, u, v, qp, mbw, mbh):
+    ldc, lac, cdc, cac = jaxcore._encode_intra(y, u, v, qp, mbw=mbw, mbh=mbh)
+    return jnp.concatenate([
+        ldc.reshape(-1), lac.reshape(-1), cdc.reshape(-1), cac.reshape(-1)])
+
+
 @functools.partial(jax.jit, static_argnames=("mbw", "mbh", "mesh"))
 def _encode_wave(ys, us, vs, qp, *, mbw: int, mbh: int, mesh: Mesh):
-    """ys: (G, F, H, W) uint8 sharded over `gop`; returns level arrays with
-    leading (G, F) dims."""
+    """ys: (G, F, H, W) uint8 sharded over `gop`.
+
+    Returns per-frame sparse-packed levels (jaxcore._sparse_pack — ~10x
+    fewer device→host bytes than raw int32) with leading (G, F) dims;
+    the host checks the nnz/escape counts for the rare dense fallback.
+    """
 
     def per_gop(y_g, u_g, v_g):
         # y_g: (1, F, H, W) — this device's GOP(s)
         def per_frame(planes):
             y, u, v = planes
-            return jaxcore._encode_intra(y, u, v, qp, mbw=mbw, mbh=mbh)
+            return jaxcore._sparse_pack(_flat_levels(y, u, v, qp, mbw, mbh))
 
         def one(y_f, u_f, v_f):
             return jax.lax.map(per_frame, (y_f, u_f, v_f))
 
-        return jax.vmap(one)(y_g, u_g, v_g)
+        return jax.vmap(one)(y_g, u_g, v_g)               # each (1, F, ...)
 
     shard = jax.shard_map(
         per_gop, mesh=mesh,
         in_specs=(P("gop"), P("gop"), P("gop")),
-        out_specs=(P("gop"), P("gop"), P("gop"), P("gop")),
+        out_specs=(P("gop"),) * 6,
+    )
+    return shard(ys, us, vs)
+
+
+@functools.partial(jax.jit, static_argnames=("mbw", "mbh", "mesh", "dtype"))
+def _encode_wave_dense(ys, us, vs, qp, *, mbw: int, mbh: int, mesh: Mesh,
+                       dtype):
+    """Dense fallback: (G, F, L) levels in `dtype` (int16 covers the full
+    CAVLC level range)."""
+
+    def per_gop(y_g, u_g, v_g):
+        def per_frame(planes):
+            y, u, v = planes
+            return _flat_levels(y, u, v, qp, mbw, mbh)
+
+        def one(y_f, u_f, v_f):
+            return jax.lax.map(per_frame, (y_f, u_f, v_f))
+
+        return jax.vmap(one)(y_g, u_g, v_g).astype(dtype)
+
+    shard = jax.shard_map(
+        per_gop, mesh=mesh,
+        in_specs=(P("gop"), P("gop"), P("gop")),
+        out_specs=P("gop"),
     )
     return shard(ys, us, vs)
 
@@ -78,15 +113,22 @@ class GopShardEncoder:
         return plan_segments(num_frames, self.gop_frames, self.num_devices,
                              self.max_segments)
 
-    def encode(self, frames: list[Frame]) -> list[EncodedSegment]:
+    def stage_waves(self, frames: list[Frame]):
+        """Host-side staging generator: stack frames into per-wave
+        (G, F, H, W) device arrays (HBM-resident input is the design
+        invariant — SURVEY.md §0: kernels run over HBM-resident YUV
+        planes). Lazily, one wave per iteration, so a long clip never
+        pins more than the pipeline window of waves in HBM."""
+        from ..core.types import ChromaFormat
+
+        bad = next((f for f in frames
+                    if f.chroma is not ChromaFormat.YUV420), None)
+        if bad is not None:
+            raise ValueError(
+                f"GopShardEncoder supports only 4:2:0 input, got "
+                f"{bad.chroma.name}; convert before encoding")
         plan = self.plan(len(frames))
         padded = [f.padded(16) for f in frames]
-        ph, pw = padded[0].y.shape
-        mbh, mbw = ph // 16, pw // 16
-        luma_mode, chroma_mode = _mode_policy(mbw, mbh)
-        qp = jnp.asarray(self.qp)
-
-        segments: list[EncodedSegment] = []
         D = self.num_devices
         gops = list(plan.gops)
         for wave_start in range(0, len(gops), D):
@@ -99,18 +141,63 @@ class GopShardEncoder:
             ys = np.stack([self._gop_plane(padded, g, F, "y") for g in full])
             us = np.stack([self._gop_plane(padded, g, F, "u") for g in full])
             vs = np.stack([self._gop_plane(padded, g, F, "v") for g in full])
-            out = _encode_wave(jnp.asarray(ys), jnp.asarray(us),
-                               jnp.asarray(vs), qp,
-                               mbw=mbw, mbh=mbh, mesh=self.mesh)
-            luma_dc, luma_ac, chroma_dc, chroma_ac = (np.asarray(o) for o in out)
+            yield (wave, jnp.asarray(ys), jnp.asarray(us), jnp.asarray(vs))
+
+    def prepare_waves(self, frames: list[Frame]
+                      ) -> tuple[SegmentPlan, list[tuple]]:
+        """Eager staging of ALL waves (benchmarks / short clips); for
+        long clips prefer encode(), which streams with a bounded window."""
+        return self.plan(len(frames)), list(self.stage_waves(frames))
+
+    def encode(self, frames: list[Frame]) -> list[EncodedSegment]:
+        return self.encode_waves(self.stage_waves(frames))
+
+    def encode_waves(self, waves) -> list[EncodedSegment]:
+        """Dispatch staged waves: device compute → sparse fetch → host
+        entropy pack, in wave order.
+
+        Depth-2 pipelining: wave i+1 is staged and dispatched before
+        wave i's fetch, so its compute overlaps the fetch + pack without
+        pinning the whole clip in device memory.
+        """
+        qp = jnp.asarray(self.qp)
+        segments: list[EncodedSegment] = []
+        waves = iter(waves)
+        pending: list[tuple] = []
+
+        def dispatch_next():
+            try:
+                wave, ysd, usd, vsd = next(waves)
+            except StopIteration:
+                return
+            ph, pw = ysd.shape[2], ysd.shape[3]
+            mbh, mbw = ph // 16, pw // 16
+            out = _encode_wave(ysd, usd, vsd, qp, mbw=mbw, mbh=mbh,
+                               mesh=self.mesh)
+            pending.append((wave, ysd, usd, vsd, mbw, mbh, out))
+
+        dispatch_next()
+        while pending:
+            dispatch_next()                       # overlap: depth-2 window
+            wave, ysd, usd, vsd, mbw, mbh, out = pending.pop(0)
+            L = mbw * mbh * 384
+            nnz, n_esc, bitmap, vals, esc_pos, esc_val = jax.device_get(out)
+            sparse_ok = jaxcore.sparse_fits(nnz.max(), n_esc.max(), L)
+            if not sparse_ok:
+                flat = jax.device_get(_encode_wave_dense(
+                    ysd, usd, vsd, qp, mbw=mbw, mbh=mbh,
+                    mesh=self.mesh, dtype=jnp.int16))
             for gi, gop in enumerate(wave):
                 payload = []
                 for fi in range(gop.num_frames):
-                    levels = FrameLevels(
-                        luma_mode=luma_mode, chroma_mode=chroma_mode,
-                        luma_dc=luma_dc[gi, fi], luma_ac=luma_ac[gi, fi],
-                        chroma_dc=chroma_dc[gi, fi], chroma_ac=chroma_ac[gi, fi],
-                    )
+                    if sparse_ok:
+                        raw = jaxcore._sparse_unpack(
+                            int(nnz[gi, fi]), int(n_esc[gi, fi]),
+                            bitmap[gi, fi], vals[gi, fi],
+                            esc_pos[gi, fi], esc_val[gi, fi], L)
+                    else:
+                        raw = flat[gi, fi]
+                    levels = jaxcore._unpack_levels(raw, mbw, mbh)
                     nal = pack_slice(levels, mbw, mbh, self.sps, self.pps,
                                      self.qp, idr=True,
                                      idr_pic_id=(gop.start_frame + fi) % 65536)
