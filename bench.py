@@ -22,23 +22,32 @@ import time
 import numpy as np
 
 
-def make_frames(n: int, w: int, h: int, seed: int = 0):
-    """Synthetic but non-trivial content: gradients + texture + noise."""
+def make_frames(n: int, w: int, h: int, seed: int = 0, pan: int = 3):
+    """Synthetic video-like content: a camera pan over a fixed detailed
+    scene (gradient + texture + static grain), `pan` px/frame diagonal.
+    Motion-predictable like real footage — unlike per-frame iid noise,
+    which no codec (or hardware encoder) can inter-predict."""
     from thinvids_tpu.core.types import Frame
 
     rng = np.random.default_rng(seed)
-    yy, xx = np.mgrid[0:h, 0:w]
+    pad = pan * n + 2
+    yy, xx = np.mgrid[0:h + pad, 0:w + pad]
+    scene = (xx * 0.1 + yy * 0.05) % 256 \
+        + 24.0 * np.sin(xx * 0.07) * np.cos(yy * 0.05) \
+        + rng.normal(0, 6.0, (h + pad, w + pad))
+    scene = np.clip(scene, 0, 255).astype(np.uint8)
+    scene_u = np.clip(128 + 30 * np.sin(xx[::2, ::2] * 0.01),
+                      0, 255).astype(np.uint8)
+    scene_v = np.clip(128 + 30 * np.cos(yy[::2, ::2] * 0.01),
+                      0, 255).astype(np.uint8)
     frames = []
     for i in range(n):
-        base = (xx * 0.1 + yy * 0.05 + i * 4.0) % 256
-        texture = 24.0 * np.sin(xx * 0.07 + i * 0.3) * np.cos(yy * 0.05)
-        noise = rng.normal(0, 6.0, (h, w))
-        y = np.clip(base + texture + noise, 0, 255).astype(np.uint8)
-        u = np.clip(128 + 30 * np.sin(xx[::2, ::2] * 0.01 + i * 0.1),
-                    0, 255).astype(np.uint8)
-        v = np.clip(128 + 30 * np.cos(yy[::2, ::2] * 0.01 + i * 0.1),
-                    0, 255).astype(np.uint8)
-        frames.append(Frame(y=y, u=u, v=v))
+        dy = dx = pan * i
+        frames.append(Frame(
+            y=scene[dy:dy + h, dx:dx + w],
+            u=scene_u[dy // 2:dy // 2 + h // 2, dx // 2:dx // 2 + w // 2],
+            v=scene_v[dy // 2:dy // 2 + h // 2, dx // 2:dx // 2 + w // 2],
+        ))
     return frames
 
 
@@ -85,10 +94,8 @@ def main() -> None:
 
     gop_frames = 8
     enc_sharded = GopShardEncoder(meta, qp=qp, gop_frames=gop_frames)
-    plan, waves = enc_sharded.prepare_waves(frames)
-    for arr_tuple in waves:
-        import jax as _jax
-        _jax.block_until_ready(arr_tuple[1])
+    _, waves = enc_sharded.prepare_waves(frames)
+    jax.block_until_ready([w[1:] for w in waves])   # force HBM staging
     concat_segments(enc_sharded.encode_waves(waves))   # warm compile
     t0 = time.perf_counter()
     stream = concat_segments(enc_sharded.encode_waves(waves))
@@ -98,7 +105,7 @@ def main() -> None:
     fps = nframes / t_e2e
     device_fps = nframes / t_device
     result = {
-        "metric": "h264_intra_1080p_fps",
+        "metric": "h264_gop_1080p_fps",
         "value": round(fps, 2),
         "unit": "fps",
         "vs_baseline": round(fps / 30.0, 3),

@@ -1,0 +1,144 @@
+"""Inter-frame (P) coding tests: GOP encode vs the libavcodec oracle.
+
+The conformance bar is bit-exactness: the oracle's decoded planes must
+equal the encoder's closed-loop reconstruction for every frame, across
+content that exercises motion search, skip runs, MV prediction edge
+cases, and frame cropping.
+"""
+
+import numpy as np
+import pytest
+
+from thinvids_tpu.codecs.h264.encoder import encode_frames, encode_gop
+from thinvids_tpu.codecs.h264.inter import (
+    CBP_INTER_TO_CODE,
+    _CODE_TO_CBP_INTER,
+    predict_mvs,
+)
+from thinvids_tpu.core.types import Frame, VideoMeta
+from thinvids_tpu.tools import oracle
+
+
+def translating_clip(w, h, n, step=3, noise=2.0, seed=0):
+    """Pattern moving `step` px/frame — exercises non-zero MVs."""
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:h, 0:w]
+    frames = []
+    for i in range(n):
+        y = np.clip(((xx * 3 + yy * 2 + step * i) % 256)
+                    + rng.normal(0, noise, (h, w)), 0, 255).astype(np.uint8)
+        u = np.clip(128 + 20 * np.sin(xx[::2, ::2] * 0.1 + i * 0.5),
+                    0, 255).astype(np.uint8)
+        v = np.clip(128 + 20 * np.cos(yy[::2, ::2] * 0.1 + i * 0.5),
+                    0, 255).astype(np.uint8)
+        frames.append(Frame(y, u, v))
+    return frames
+
+
+def static_clip(w, h, n):
+    """Identical frames — P frames should collapse to skip runs."""
+    yy, xx = np.mgrid[0:h, 0:w]
+    y = ((xx + yy) % 256).astype(np.uint8)
+    u = np.full((h // 2, w // 2), 100, np.uint8)
+    v = np.full((h // 2, w // 2), 150, np.uint8)
+    return [Frame(y.copy(), u.copy(), v.copy()) for _ in range(n)]
+
+
+def assert_bit_exact(frames, meta, qp, **kw):
+    stream, recons = encode_gop(frames, meta, qp=qp, return_recon=True, **kw)
+    decoded = oracle.decode_h264(stream)
+    assert len(decoded) == len(frames)
+    ry, ru, rv = recons
+    for i, (oy, ou, ov) in enumerate(decoded):
+        # The oracle returns display (cropped) planes; recon is padded.
+        for name, got, want in (("y", oy, ry[i]), ("u", ou, ru[i]),
+                                ("v", ov, rv[i])):
+            want = np.asarray(want).astype(np.uint8)
+            np.testing.assert_array_equal(
+                got, want[:got.shape[0], :got.shape[1]],
+                err_msg=f"frame {i} {name}")
+    return stream
+
+
+class TestCbpTable:
+    def test_bijective(self):
+        assert sorted(_CODE_TO_CBP_INTER) == list(range(48))
+        for cbp in range(48):
+            assert _CODE_TO_CBP_INTER[CBP_INTER_TO_CODE[cbp]] == cbp
+
+
+class TestMvPrediction:
+    def test_uniform_field_predicts_itself(self):
+        mv = np.tile(np.array([2, -3], np.int32), (12, 1))
+        mvp, skip = predict_mvs(mv, 4, 3)
+        # Interior MBs: median of identical vectors is the vector.
+        assert np.array_equal(mvp[5], [2, -3])
+        # Top-left corner: nothing available -> zero.
+        assert np.array_equal(mvp[0], [0, 0])
+        # First row beyond MB0: A-only rule.
+        assert np.array_equal(mvp[1], [2, -3])
+
+    def test_skip_mv_zero_conditions(self):
+        # Any zero-MV left/top neighbor forces the skip predictor to 0.
+        mv = np.tile(np.array([2, 2], np.int32), (9, 1))
+        mv[4] = 0                      # center MB of a 3x3 grid
+        mvp, skip = predict_mvs(mv, 3, 3)
+        assert np.array_equal(skip[5], [0, 0])   # left neighbor (4) is zero
+        assert np.array_equal(skip[7], [0, 0])   # top neighbor (4) is zero
+        assert np.array_equal(skip[0], [0, 0])   # edge: A/B unavailable
+
+
+@pytest.mark.skipif(not oracle.oracle_available(), reason="libavcodec missing")
+class TestGopConformance:
+    @pytest.mark.parametrize("qp", [20, 27, 35])
+    def test_translating_motion_bit_exact(self, qp):
+        w, h, n = 64, 48, 6
+        frames = translating_clip(w, h, n)
+        meta = VideoMeta(width=w, height=h, num_frames=n)
+        assert_bit_exact(frames, meta, qp)
+
+    def test_static_clip_skips_and_is_tiny(self):
+        w, h, n = 96, 64, 8
+        frames = static_clip(w, h, n)
+        meta = VideoMeta(width=w, height=h, num_frames=n)
+        stream = assert_bit_exact(frames, meta, 27)
+        intra_stream = encode_frames(frames, meta, qp=27)
+        # 7 of 8 frames should be nearly all skip runs.
+        assert len(stream) < len(intra_stream) / 4
+
+    def test_cropped_dimensions(self):
+        # Non-MB-multiple dims exercise padding + cropping with motion.
+        w, h, n = 70, 50, 5
+        frames = translating_clip(w, h, n, step=2)
+        meta = VideoMeta(width=w, height=h, num_frames=n)
+        assert_bit_exact(frames, meta, 27)
+
+    def test_fast_motion_hits_search_range(self):
+        # 12 px/frame translation requires |mv| up to the search range.
+        w, h, n = 96, 64, 4
+        frames = translating_clip(w, h, n, step=12, noise=0.0)
+        meta = VideoMeta(width=w, height=h, num_frames=n)
+        assert_bit_exact(frames, meta, 27)
+
+    def test_noise_content_bit_exact(self):
+        # Uncorrelated noise: ME finds junk vectors, residuals are dense —
+        # stresses CAVLC inter paths and CBP corners.
+        rng = np.random.default_rng(5)
+        w, h, n = 48, 32, 4
+        frames = [Frame(
+            y=rng.integers(0, 256, (h, w), dtype=np.uint8),
+            u=rng.integers(0, 256, (h // 2, w // 2), dtype=np.uint8),
+            v=rng.integers(0, 256, (h // 2, w // 2), dtype=np.uint8),
+        ) for _ in range(n)]
+        meta = VideoMeta(width=w, height=h, num_frames=n)
+        assert_bit_exact(frames, meta, 30)
+
+    def test_gop_beats_all_intra_3x_on_low_motion(self):
+        # The VERDICT acceptance bar: >=3x smaller than all-IDR at qp 27
+        # on a low-motion clip.
+        w, h, n = 128, 96, 10
+        frames = translating_clip(w, h, n, step=1, noise=1.0)
+        meta = VideoMeta(width=w, height=h, num_frames=n)
+        stream = assert_bit_exact(frames, meta, 27)
+        intra_stream = encode_frames(frames, meta, qp=27)
+        assert len(stream) * 3 <= len(intra_stream)

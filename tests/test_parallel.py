@@ -98,7 +98,8 @@ class TestShardedDispatch:
         frames = _make_frames(16)
         meta = VideoMeta(width=64, height=48, fps_num=30, fps_den=1,
                          num_frames=16)
-        got = encode_clip_sharded(frames, meta, qp=27, gop_frames=2)
+        got = encode_clip_sharded(frames, meta, qp=27, gop_frames=2,
+                                  inter=False)
         want = _reference_stream(frames, meta, 27, 2, len(jax.devices()))
         assert got == want
 
@@ -107,7 +108,8 @@ class TestShardedDispatch:
         frames = _make_frames(10, seed=3)
         meta = VideoMeta(width=64, height=48, num_frames=10)
         mesh = default_mesh()
-        enc = GopShardEncoder(meta, qp=30, mesh=mesh, gop_frames=3)
+        enc = GopShardEncoder(meta, qp=30, mesh=mesh, gop_frames=3,
+                              inter=False)
         segments = enc.encode(frames)
         got = concat_segments(segments)
         plan = enc.plan(len(frames))
@@ -125,7 +127,8 @@ class TestShardedDispatch:
             u=np.full((24, 32), 100 + i, np.uint8),
             v=np.full((24, 32), 140 - i, np.uint8),
         ) for i in range(8)]
-        got = encode_clip_sharded(smooth, meta, qp=30, gop_frames=2)
+        got = encode_clip_sharded(smooth, meta, qp=30, gop_frames=2,
+                                  inter=False)
         want = _reference_stream(smooth, meta, 30, 2, len(jax.devices()))
         assert got == want
 
@@ -154,6 +157,50 @@ class TestShardedDispatch:
 
         frames = _make_frames(8, seed=7)
         meta = VideoMeta(width=64, height=48, num_frames=8)
-        stream = encode_clip_sharded(frames, meta, qp=27, gop_frames=2)
+        stream = encode_clip_sharded(frames, meta, qp=27, gop_frames=2,
+                                     inter=False)
         decoded = decode_annexb(stream)
         assert len(decoded.frames) == 8
+
+
+class TestShardedInterDispatch:
+    """Sharded GOP (IDR + P) coding across the virtual mesh."""
+
+    def test_sharded_gop_matches_single_device_encode_gop(self):
+        from thinvids_tpu.codecs.h264.encoder import encode_gop
+
+        frames = _make_frames(16, seed=11)
+        meta = VideoMeta(width=64, height=48, num_frames=16)
+        got = encode_clip_sharded(frames, meta, qp=27, gop_frames=2)
+        plan = plan_segments(16, 2, len(jax.devices()))
+        parts = []
+        for gop in plan.gops:
+            parts.append(encode_gop(
+                frames[gop.start_frame:gop.end_frame], meta, qp=27,
+                idr_pic_id=gop.index))
+        assert got == b"".join(parts)
+
+    def test_sharded_gop_oracle_bit_exact(self):
+        from thinvids_tpu.tools import oracle
+
+        if not oracle.oracle_available():
+            pytest.skip("libavcodec missing")
+        # Low-motion clip: decode the full sharded stream with libavcodec
+        # and check frame count + that P frames made it smaller.
+        n = 64
+        meta = VideoMeta(width=64, height=48, num_frames=n)
+        yy, xx = np.mgrid[0:48, 0:64]
+        frames = [Frame(
+            y=(((xx + 2 * i) % 256)).astype(np.uint8),
+            u=np.full((24, 32), 90, np.uint8),
+            v=np.full((24, 32), 160, np.uint8),
+        ) for i in range(n)]
+        inter_stream = encode_clip_sharded(frames, meta, qp=27, gop_frames=8)
+        intra_stream = encode_clip_sharded(frames, meta, qp=27, gop_frames=8,
+                                           inter=False)
+        decoded = oracle.decode_h264(inter_stream)
+        assert len(decoded) == n
+        # IDR cost dominates on this cheap-intra clip: 8-frame GOPs cap
+        # the win well below the gop ratio (the >=3x bar on realistic
+        # content is asserted in test_inter.py).
+        assert len(inter_stream) < len(intra_stream) / 1.7

@@ -309,10 +309,96 @@ class H264Encoder:
 
 def encode_frames(frames: list[Frame], meta: VideoMeta, qp: int = 27,
                   use_jax: bool = True) -> bytes:
-    """Encode a closed sequence of frames to one Annex-B byte stream."""
+    """Encode a closed sequence of frames to one Annex-B byte stream
+    (all-intra: every frame IDR)."""
     enc = H264Encoder(meta, qp=qp, use_jax=use_jax)
     out = []
     for i, frame in enumerate(frames):
         out.append(enc.encode_frame(frame, idr_pic_id=i,
                                     with_headers=(i == 0)))
     return b"".join(out)
+
+
+def encode_gop(frames: list[Frame], meta: VideoMeta, qp: int = 27,
+               idr_pic_id: int = 0, with_headers: bool = True,
+               return_recon: bool = False):
+    """Encode a closed GOP: frame 0 IDR, frames 1..F-1 inter-coded (P).
+
+    The whole GOP's compute (intra frame + motion search / compensation /
+    transform chained through a `lax.scan` recon carry) is ONE jitted XLA
+    program (jaxinter.encode_gop_jit); this host half packs the I-slice
+    and P-slices. Replaces the reference's inter-coded ffmpeg op point
+    (/root/reference/worker/tasks.py:1558-1586).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ...core.types import ChromaFormat
+    from . import jaxinter
+
+    if not frames:
+        raise ValueError("empty GOP")
+    bad = next((f for f in frames
+                if f.chroma is not ChromaFormat.YUV420), None)
+    if bad is not None:
+        raise ValueError(
+            f"encode_gop supports only 4:2:0 input, got {bad.chroma.name}")
+    padded = [f.padded(16) for f in frames]
+    ph, pw = padded[0].y.shape
+    mbh, mbw = ph // 16, pw // 16
+    ys = jnp.asarray(np.stack([p.y for p in padded]))
+    us = jnp.asarray(np.stack([p.u for p in padded]))
+    vs = jnp.asarray(np.stack([p.v for p in padded]))
+
+    out = jaxinter.encode_gop_jit(ys, us, vs, jnp.asarray(qp),
+                                  mbw=mbw, mbh=mbh,
+                                  emit_recon=return_recon)
+    if return_recon:
+        (intra, pouts, recons) = jax.device_get(out)
+    else:
+        (intra, pouts) = jax.device_get(out)
+    il_dc, il_ac, ic_dc, ic_ac = intra
+    mv, l16, cdc, cac = pouts
+
+    sps = SPS(width=meta.width, height=meta.height,
+              fps_num=meta.fps_num, fps_den=meta.fps_den)
+    pps = PPS(init_qp=qp)
+    nals = pack_gop_slices(intra, pouts, len(frames), mbw, mbh, sps, pps,
+                           qp, idr_pic_id, with_headers=with_headers)
+    stream = b"".join(nals)
+    if return_recon:
+        return stream, recons
+    return stream
+
+
+def pack_gop_slices(intra, pouts, num_frames: int, mbw: int, mbh: int,
+                    sps: SPS, pps: PPS, qp: int, idr_pic_id: int,
+                    with_headers: bool = True) -> list[bytes]:
+    """Entropy-pack one GOP's slices from device level arrays.
+
+    The single shared host half of GOP encoding — both the single-device
+    path (encode_gop) and the sharded path (GopShardEncoder._pack_gop)
+    call this, so the bit-identity contract between them cannot drift.
+
+    intra: (luma_dc, luma_ac, chroma_dc, chroma_ac); pouts: the P
+    frames' (mv, luma16, chroma_dc, chroma_ac), leading dim >= num
+    frames - 1 (extra tail-padding entries are ignored).
+    """
+    from . import inter as inter_mod
+
+    il_dc, il_ac, ic_dc, ic_ac = intra
+    mv, l16, cdc, cac = pouts
+    luma_mode, chroma_mode = _mode_policy(mbw, mbh)
+    intra_levels = FrameLevels(
+        luma_mode=luma_mode, chroma_mode=chroma_mode,
+        luma_dc=il_dc, luma_ac=il_ac, chroma_dc=ic_dc, chroma_ac=ic_ac)
+    nals = []
+    head = sps.to_nal() + pps.to_nal() if with_headers else b""
+    nals.append(head + pack_slice(intra_levels, mbw, mbh, sps, pps, qp,
+                                  frame_num=0, idr=True,
+                                  idr_pic_id=idr_pic_id % 65536))
+    for i in range(num_frames - 1):
+        nals.append(inter_mod.pack_p_slice(
+            mv[i], l16[i], cdc[i], cac[i], mbw, mbh, sps, pps, qp,
+            frame_num=(i + 1) % 256))
+    return nals

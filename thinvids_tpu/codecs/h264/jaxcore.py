@@ -42,6 +42,19 @@ _H2 = jnp.asarray([[1, 1], [1, -1]], dtype=jnp.int32)
 _ZSCAN = jnp.asarray([by * 4 + bx for (bx, by) in LUMA_BLOCK_ORDER])
 
 
+def _varying_zero(x):
+    """A zero int32 scalar DERIVED from `x`, not a constant.
+
+    Under `shard_map`, values built from plain constants are unvarying
+    over the mesh axes while data-derived values are varying; a
+    `lax.scan` whose init carry is unvarying but whose carry output is
+    varying fails the carry-type check. Deriving the zero from the
+    sharded input gives inits the same varying manual axes. Do NOT
+    simplify `zeros + _varying_zero(x)` to `zeros`.
+    """
+    return (x.reshape(-1)[0] * 0).astype(jnp.int32)
+
+
 def _fwd4(x):
     return jnp.einsum("ij,...jk,lk->...il", _CF, x, _CF)
 
@@ -159,6 +172,11 @@ def _chroma_mb_batch(src, pred, qpc):
 
 @functools.partial(jax.jit, static_argnames=("mbw", "mbh"))
 def _encode_intra(y, u, v, qp, *, mbw: int, mbh: int):
+    """Jitted intra compute: level arrays only (recon DCE'd away)."""
+    return _intra_core(y, u, v, qp, mbw=mbw, mbh=mbh)[:4]
+
+
+def _intra_core(y, u, v, qp, *, mbw: int, mbh: int):
     qp = qp.astype(jnp.int32)
     qpc = _QPC[jnp.clip(qp, 0, 51)]
     y = y.astype(jnp.int32)
@@ -186,11 +204,7 @@ def _encode_intra(y, u, v, qp, *, mbw: int, mbh: int):
         return carry, (ydc[0], yac[0], udc[0], uac[0], vdc[0], vac[0],
                        yrec[0], urec[0], vrec[0])
 
-    # The init carry must be derived from the input so that under
-    # `shard_map` it carries the same varying manual axes as the scan
-    # outputs (a plain jnp.zeros constant is unvarying and trips the
-    # carry-type check on a sharded mesh). `zero` is a data-dependent 0.
-    zero = (y[0, 0] * 0).astype(jnp.int32)
+    zero = _varying_zero(y)        # see _varying_zero: shard_map carries
     init = (jnp.zeros(16, jnp.int32) + zero, jnp.zeros(8, jnp.int32) + zero,
             jnp.zeros(8, jnp.int32) + zero, zero)
     _, row0_out = jax.lax.scan(row0_step, init, (y_row0, u_row0, v_row0))
@@ -217,24 +231,35 @@ def _encode_intra(y, u, v, qp, *, mbw: int, mbh: int):
             vdc, vac, vrec = _chroma_mb_batch(sv, pred_v, qpc)
             carry = (yrec[:, -1, :].reshape(-1), urec[:, -1, :].reshape(-1),
                      vrec[:, -1, :].reshape(-1))
-            return carry, (ydc, yac, udc, uac, vdc, vac)
+            return carry, (ydc, yac, udc, uac, vdc, vac, yrec, urec, vrec)
 
         _, rows_out = jax.lax.scan(
             row_step, (bottom_y, bottom_u, bottom_v), (y_rows, u_rows, v_rows))
-        ydc_r, yac_r, udc_r, uac_r, vdc_r, vac_r = rows_out
+        (ydc_r, yac_r, udc_r, uac_r, vdc_r, vac_r,
+         yrec_r, urec_r, vrec_r) = rows_out
         luma_dc = jnp.concatenate([r0_ydc[None], ydc_r]).reshape(-1, 16)
         luma_ac = jnp.concatenate([r0_yac[None], yac_r]).reshape(-1, 16, 15)
         u_dc = jnp.concatenate([r0_udc[None], udc_r]).reshape(-1, 4)
         u_ac = jnp.concatenate([r0_uac[None], uac_r]).reshape(-1, 4, 15)
         v_dc = jnp.concatenate([r0_vdc[None], vdc_r]).reshape(-1, 4)
         v_ac = jnp.concatenate([r0_vac[None], vac_r]).reshape(-1, 4, 15)
+        yrec_all = jnp.concatenate([r0_yrec[None], yrec_r])  # (mbh,mbw,16,16)
+        urec_all = jnp.concatenate([r0_urec[None], urec_r])
+        vrec_all = jnp.concatenate([r0_vrec[None], vrec_r])
     else:
         luma_dc, luma_ac = r0_ydc, r0_yac
         u_dc, u_ac, v_dc, v_ac = r0_udc, r0_uac, r0_vdc, r0_vac
+        yrec_all = r0_yrec[None]
+        urec_all = r0_urec[None]
+        vrec_all = r0_vrec[None]
 
     chroma_dc = jnp.stack([u_dc, v_dc], axis=1)                  # (nmb,2,4)
     chroma_ac = jnp.stack([u_ac, v_ac], axis=1)                  # (nmb,2,4,15)
-    return luma_dc, luma_ac, chroma_dc, chroma_ac
+    recon_y = yrec_all.transpose(0, 2, 1, 3).reshape(16 * mbh, 16 * mbw)
+    recon_u = urec_all.transpose(0, 2, 1, 3).reshape(8 * mbh, 8 * mbw)
+    recon_v = vrec_all.transpose(0, 2, 1, 3).reshape(8 * mbh, 8 * mbw)
+    return (luma_dc, luma_ac, chroma_dc, chroma_ac,
+            recon_y, recon_u, recon_v)
 
 
 @functools.partial(jax.jit, static_argnames=("mbw", "mbh", "dtype"))
@@ -286,7 +311,7 @@ def _sparse_pack(flat):
     vals = jnp.zeros(budget + 1, jnp.int8).at[idx].set(
         clipped, mode="drop")[:budget]
     bitmap = jnp.sum(
-        mask.reshape(-1, 8).astype(jnp.uint8) * _BIT_WEIGHTS, axis=-1
+        _pad8(mask).reshape(-1, 8).astype(jnp.uint8) * _BIT_WEIGHTS, axis=-1
     ).astype(jnp.uint8)
     esc_mask = jnp.abs(flat) > _I8_MAX
     n_esc = jnp.sum(esc_mask.astype(jnp.int32))
@@ -297,6 +322,14 @@ def _sparse_pack(flat):
     esc_val = jnp.zeros(_SPARSE_ESCAPES + 1, jnp.int32).at[eidx].set(
         flat, mode="drop")[:_SPARSE_ESCAPES]
     return nnz, n_esc, bitmap, vals, esc_pos, esc_val
+
+
+def _pad8(mask):
+    L = mask.shape[0]
+    pad = (-L) % 8
+    if pad:
+        mask = jnp.concatenate([mask, jnp.zeros(pad, mask.dtype)])
+    return mask
 
 
 def sparse_fits(nnz: int, n_esc: int, L: int) -> bool:

@@ -85,9 +85,23 @@ def _build_and_load() -> ctypes.CDLL:
             ctypes.c_int32, ctypes.c_int32,             # mbw, mbh
             ctypes.c_void_p, ctypes.c_int64,            # out, cap
         ]
+        lib.cavlc_init_inter.argtypes = [ctypes.c_void_p]
+        lib.cavlc_pack_pslice.restype = ctypes.c_int64
+        lib.cavlc_pack_pslice.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32,            # header bytes, bitlen
+            ctypes.c_void_p,                            # mv
+            ctypes.c_void_p,                            # luma16
+            ctypes.c_void_p, ctypes.c_void_p,           # chroma dc/ac
+            ctypes.c_int32, ctypes.c_int32,             # mbw, mbh
+            ctypes.c_void_p, ctypes.c_int64,            # out, cap
+        ]
         arrs = _marshal_tables()
-        lib._table_refs = arrs  # keep alive
+        from ..codecs.h264.inter import CBP_INTER_TO_CODE
+
+        cbp_inter = np.asarray(CBP_INTER_TO_CODE, np.int32)
+        lib._table_refs = arrs + (cbp_inter,)  # keep alive
         lib.cavlc_init_tables(*(a.ctypes.data for a in arrs))
+        lib.cavlc_init_inter(cbp_inter.ctypes.data)
         _lib = lib
         return lib
 
@@ -131,6 +145,42 @@ def pack_islice(header_bytes: bytes, header_bit_len: int,
         hdr.ctypes.data, header_bit_len,
         luma_mode.ctypes.data, chroma_mode.ctypes.data,
         luma_dc.ctypes.data, luma_ac.ctypes.data,
+        chroma_dc.ctypes.data, chroma_ac.ctypes.data,
+        mbw, mbh, out.ctypes.data, cap)
+    if n == -2:
+        raise RuntimeError("native packer output buffer overflow")
+    if n == -3:
+        raise ValueError("level too large for baseline CAVLC")
+    if n < 0:
+        raise RuntimeError(f"native packer failed ({n})")
+    return out[:n].tobytes()
+
+
+def pack_pslice(header_bytes: bytes, header_bit_len: int, mv: np.ndarray,
+                luma16: np.ndarray, chroma_dc: np.ndarray,
+                chroma_ac: np.ndarray, mbw: int, mbh: int) -> bytes:
+    """Pack one P-slice (header bits + MB layer) and return the EBSP
+    payload. Mirrors codecs/h264/inter.pack_p_slice bit-for-bit."""
+    lib = _build_and_load()
+    nmb = mbw * mbh
+
+    def prep(a, shape):
+        a = np.ascontiguousarray(a, np.int32)
+        if a.shape != shape:
+            raise ValueError(f"bad array shape {a.shape}, want {shape}")
+        return a
+
+    mv = prep(mv, (nmb, 2))
+    luma16 = prep(luma16, (nmb, 16, 16))
+    chroma_dc = prep(chroma_dc, (nmb, 2, 4))
+    chroma_ac = prep(chroma_ac, (nmb, 2, 4, 15))
+
+    cap = max(8192, nmb * 4096)
+    out = np.empty(cap, np.uint8)
+    hdr = np.frombuffer(header_bytes, np.uint8)
+    n = lib.cavlc_pack_pslice(
+        hdr.ctypes.data, header_bit_len,
+        mv.ctypes.data, luma16.ctypes.data,
         chroma_dc.ctypes.data, chroma_ac.ctypes.data,
         mbw, mbh, out.ctypes.data, cap)
     if n == -2:

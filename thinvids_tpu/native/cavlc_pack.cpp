@@ -128,6 +128,35 @@ static int encode_residual(BitWriter& bw, const int32_t* coeffs, int n, int nc) 
   return total;
 }
 
+
+// Neighbor-average nC lookup over a counts grid (width w); A=left, B=top.
+static inline int nc_from_counts(const int32_t* cnt, int w, int gy, int gx) {
+  bool a = gx > 0, b = gy > 0;
+  int na = a ? cnt[(size_t)gy * w + gx - 1] : 0;
+  int nb = b ? cnt[(size_t)(gy - 1) * w + gx] : 0;
+  if (a && b) return (na + nb + 1) >> 1;
+  if (a) return na;
+  if (b) return nb;
+  return 0;
+}
+
+// Emulation prevention: rbsp -> ebsp into `out`. Returns byte length or -2.
+static int64_t emit_ebsp(const BitWriter& bw, uint8_t* out, int64_t out_cap) {
+  int64_t o = 0;
+  int zeros = 0;
+  for (uint8_t b : bw.buf) {
+    if (zeros >= 2 && b <= 3) {
+      if (o >= out_cap) return -2;
+      out[o++] = 3;
+      zeros = 0;
+    }
+    if (o >= out_cap) return -2;
+    out[o++] = b;
+    zeros = (b == 0) ? zeros + 1 : 0;
+  }
+  return o;
+}
+
 }  // namespace
 
 extern "C" {
@@ -174,22 +203,10 @@ int64_t cavlc_pack_islice(
   std::vector<int32_t> ccnt((size_t)2 * cw * ch, 0);
 
   auto luma_nc = [&](int gy, int gx) {
-    bool a = gx > 0, b = gy > 0;
-    int na = a ? lcnt[(size_t)gy * lw + gx - 1] : 0;
-    int nb = b ? lcnt[(size_t)(gy - 1) * lw + gx] : 0;
-    if (a && b) return (na + nb + 1) >> 1;
-    if (a) return na;
-    if (b) return nb;
-    return 0;
+    return nc_from_counts(lcnt.data(), lw, gy, gx);
   };
   auto chroma_nc = [&](int ci, int gy, int gx) {
-    bool a = gx > 0, b = gy > 0;
-    int na = a ? ccnt[((size_t)ci * ch + gy) * cw + gx - 1] : 0;
-    int nb = b ? ccnt[((size_t)ci * ch + gy - 1) * cw + gx] : 0;
-    if (a && b) return (na + nb + 1) >> 1;
-    if (a) return na;
-    if (b) return nb;
-    return 0;
+    return nc_from_counts(ccnt.data() + (size_t)ci * ch * cw, cw, gy, gx);
   };
 
   for (int my = 0; my < mbh; my++) {
@@ -252,19 +269,177 @@ int64_t cavlc_pack_islice(
   bw.trailing();
 
   // Emulation prevention: rbsp -> ebsp into `out`.
-  int64_t o = 0;
-  int zeros = 0;
-  for (uint8_t b : bw.buf) {
-    if (zeros >= 2 && b <= 3) {
-      if (o >= out_cap) return -2;
-      out[o++] = 3;
-      zeros = 0;
+  return emit_ebsp(bw, out, out_cap);
+}
+
+
+// ---- P-slice support -------------------------------------------------------
+
+static int32_t g_cbp_inter[48];   // coded_block_pattern -> codeNum (Table 9-4)
+static bool g_inter_ready = false;
+
+void cavlc_init_inter(const int32_t* cbp_inter_to_code) {
+  std::memcpy(g_cbp_inter, cbp_inter_to_code, sizeof(g_cbp_inter));
+  g_inter_ready = true;
+}
+
+static inline int32_t median3(int32_t a, int32_t b, int32_t c) {
+  int32_t mn = a < b ? a : b, mx = a < b ? b : a;
+  return c < mn ? mn : (c > mx ? mx : c);
+}
+
+// Packs one P picture (all-inter, P_L0_16x16 / P_Skip, single reference,
+// integer-pel MVs). mv: nmb*2 as (dy, dx); luma16: nmb*16*16 z-scan blocks
+// of 16 zig-zag coeffs. Mirrors codecs/h264/inter.pack_p_slice bit-for-bit.
+int64_t cavlc_pack_pslice(
+    const uint8_t* header_bytes, int32_t header_bit_len,
+    const int32_t* mv,
+    const int32_t* luma16,
+    const int32_t* chroma_dc,
+    const int32_t* chroma_ac,
+    int32_t mbw, int32_t mbh, uint8_t* out, int64_t out_cap) {
+  if (!g_tables_ready || !g_inter_ready || mbw <= 0 || mbh <= 0) return -1;
+  static const int BX[16] = {0, 1, 0, 1, 2, 3, 2, 3, 0, 1, 0, 1, 2, 3, 2, 3};
+  static const int BY[16] = {0, 0, 1, 1, 0, 0, 1, 1, 2, 2, 3, 3, 2, 2, 3, 3};
+  static const int CBX[4] = {0, 1, 0, 1};
+  static const int CBY[4] = {0, 0, 1, 1};
+
+  const int nmb = mbw * mbh;
+  BitWriter bw;
+  bw.buf.reserve((size_t)nmb * 16);
+  for (int i = 0; i < header_bit_len / 8; i++) bw.write(header_bytes[i], 8);
+  if (int rem = header_bit_len % 8)
+    bw.write(header_bytes[header_bit_len / 8] >> (8 - rem), rem);
+
+  // MV prediction (median, C->D fallback) + P_Skip predictor, §8.4.1.3/1.1.
+  std::vector<int32_t> mvp((size_t)nmb * 2), skipmv((size_t)nmb * 2);
+  for (int my = 0; my < mbh; my++) {
+    for (int mx = 0; mx < mbw; mx++) {
+      const int mi = my * mbw + mx;
+      const bool avail_a = mx > 0, avail_b = my > 0;
+      const int32_t* mva_p = avail_a ? mv + (size_t)(mi - 1) * 2 : nullptr;
+      const int32_t* mvb_p = avail_b ? mv + (size_t)(mi - mbw) * 2 : nullptr;
+      int32_t mva[2] = {avail_a ? mva_p[0] : 0, avail_a ? mva_p[1] : 0};
+      int32_t mvb[2] = {avail_b ? mvb_p[0] : 0, avail_b ? mvb_p[1] : 0};
+      int32_t mvc[2] = {0, 0};
+      bool avail_c = false;
+      if (my > 0 && mx + 1 < mbw) {
+        avail_c = true;
+        mvc[0] = mv[(size_t)(mi - mbw + 1) * 2];
+        mvc[1] = mv[(size_t)(mi - mbw + 1) * 2 + 1];
+      } else if (my > 0 && mx > 0) {
+        avail_c = true;
+        mvc[0] = mv[(size_t)(mi - mbw - 1) * 2];
+        mvc[1] = mv[(size_t)(mi - mbw - 1) * 2 + 1];
+      }
+      const int n_avail = (int)avail_a + (int)avail_b + (int)avail_c;
+      int32_t p[2];
+      if (!avail_b && !avail_c && avail_a) {
+        p[0] = mva[0]; p[1] = mva[1];
+      } else if (n_avail == 1) {
+        if (avail_a)      { p[0] = mva[0]; p[1] = mva[1]; }
+        else if (avail_b) { p[0] = mvb[0]; p[1] = mvb[1]; }
+        else              { p[0] = mvc[0]; p[1] = mvc[1]; }
+      } else {
+        p[0] = median3(mva[0], mvb[0], mvc[0]);
+        p[1] = median3(mva[1], mvb[1], mvc[1]);
+      }
+      mvp[(size_t)mi * 2] = p[0];
+      mvp[(size_t)mi * 2 + 1] = p[1];
+      if (!avail_a || !avail_b || (mva[0] == 0 && mva[1] == 0)
+          || (mvb[0] == 0 && mvb[1] == 0)) {
+        skipmv[(size_t)mi * 2] = 0;
+        skipmv[(size_t)mi * 2 + 1] = 0;
+      } else {
+        skipmv[(size_t)mi * 2] = p[0];
+        skipmv[(size_t)mi * 2 + 1] = p[1];
+      }
     }
-    if (o >= out_cap) return -2;
-    out[o++] = b;
-    zeros = (b == 0) ? zeros + 1 : 0;
   }
-  return o;
+
+  const int lw = 4 * mbw, lh = 4 * mbh;
+  const int cw = 2 * mbw, ch = 2 * mbh;
+  std::vector<int32_t> lcnt((size_t)lw * lh, 0);
+  std::vector<int32_t> ccnt((size_t)2 * cw * ch, 0);
+  auto luma_nc = [&](int gy, int gx) {
+    return nc_from_counts(lcnt.data(), lw, gy, gx);
+  };
+  auto chroma_nc = [&](int ci, int gy, int gx) {
+    return nc_from_counts(ccnt.data() + (size_t)ci * ch * cw, cw, gy, gx);
+  };
+
+  uint32_t skip_run = 0;
+  for (int my = 0; my < mbh; my++) {
+    for (int mx = 0; mx < mbw; mx++) {
+      const int mi = my * mbw + mx;
+      const int32_t* l16 = luma16 + (size_t)mi * 16 * 16;
+      const int32_t* cdc = chroma_dc + (size_t)mi * 2 * 4;
+      const int32_t* cac = chroma_ac + (size_t)mi * 2 * 4 * 15;
+
+      int cbp_luma = 0;
+      for (int g = 0; g < 4; g++)
+        for (int i = 0; i < 4 * 16 && !(cbp_luma & (1 << g)); i++)
+          if (l16[g * 4 * 16 + i]) cbp_luma |= 1 << g;
+      int cbp_chroma = 0;
+      for (int i = 0; i < 2 * 4 * 15 && cbp_chroma < 2; i++)
+        if (cac[i]) cbp_chroma = 2;
+      if (cbp_chroma == 0)
+        for (int i = 0; i < 8 && !cbp_chroma; i++)
+          if (cdc[i]) cbp_chroma = 1;
+      const int cbp = cbp_luma | (cbp_chroma << 4);
+
+      const bool is_skip = cbp == 0
+          && mv[(size_t)mi * 2] == skipmv[(size_t)mi * 2]
+          && mv[(size_t)mi * 2 + 1] == skipmv[(size_t)mi * 2 + 1];
+      if (is_skip) {
+        skip_run++;
+        continue;   // neighbor counts stay 0
+      }
+      bw.ue(skip_run);
+      skip_run = 0;
+      bw.ue(0);   // mb_type = P_L0_16x16
+      // mvd: horizontal first (§7.3.5.1); layout is (dy, dx), quarter-pel.
+      bw.se(4 * (mv[(size_t)mi * 2 + 1] - mvp[(size_t)mi * 2 + 1]));
+      bw.se(4 * (mv[(size_t)mi * 2] - mvp[(size_t)mi * 2]));
+      bw.ue((uint32_t)g_cbp_inter[cbp]);
+      if (cbp) bw.se(0);   // mb_qp_delta
+
+      const int by0 = 4 * my, bx0 = 4 * mx;
+      for (int bi = 0; bi < 16; bi++) {
+        int gy = by0 + BY[bi], gx = bx0 + BX[bi];
+        if (cbp_luma & (1 << (bi / 4))) {
+          int tc = encode_residual(bw, l16 + (size_t)bi * 16, 16,
+                                   luma_nc(gy, gx));
+          if (tc < 0) return -3;
+          lcnt[(size_t)gy * lw + gx] = tc;
+        } else {
+          lcnt[(size_t)gy * lw + gx] = 0;
+        }
+      }
+      if (cbp_chroma > 0)
+        for (int ci = 0; ci < 2; ci++)
+          if (encode_residual(bw, cdc + (size_t)ci * 4, 4, -1) < 0)
+            return -3;
+      const int cy0 = 2 * my, cx0 = 2 * mx;
+      for (int ci = 0; ci < 2; ci++) {
+        for (int bi = 0; bi < 4; bi++) {
+          int gy = cy0 + CBY[bi], gx = cx0 + CBX[bi];
+          if (cbp_chroma == 2) {
+            int tc = encode_residual(bw, cac + ((size_t)ci * 4 + bi) * 15, 15,
+                                     chroma_nc(ci, gy, gx));
+            if (tc < 0) return -3;
+            ccnt[((size_t)ci * ch + gy) * cw + gx] = tc;
+          } else {
+            ccnt[((size_t)ci * ch + gy) * cw + gx] = 0;
+          }
+        }
+      }
+    }
+  }
+  if (skip_run) bw.ue(skip_run);
+  bw.trailing();
+
+  return emit_ebsp(bw, out, out_cap);
 }
 
 }  // extern "C"

@@ -39,6 +39,83 @@ def _flat_levels(y, u, v, qp, mbw, mbh):
         ldc.reshape(-1), lac.reshape(-1), cdc.reshape(-1), cac.reshape(-1)])
 
 
+# Per-MB flat sizes: intra frame (luma_dc 16 + luma_ac 240 + chroma 128)
+# and P frame (mv 2 + luma16 256 + chroma_dc 8 + chroma_ac 120).
+_INTRA_MB = 384
+_P_MB = 386
+
+
+def _gop_flat_levels(ys, us, vs, qp, mbw, mbh):
+    """(F, H, W) GOP → one flat int32 level vector:
+    [intra | P1(mv, luma16, cdc, cac) | P2 ...]."""
+    from ..codecs.h264 import jaxinter
+
+    intra, pouts = jaxinter.encode_gop_jit(ys, us, vs, qp, mbw=mbw, mbh=mbh)
+    il_dc, il_ac, ic_dc, ic_ac = intra
+    mv, l16, cdc, cac = pouts          # leading dim F-1
+    fm1 = mv.shape[0]
+    per_p = jnp.concatenate([
+        mv.reshape(fm1, -1), l16.reshape(fm1, -1),
+        cdc.reshape(fm1, -1), cac.reshape(fm1, -1)], axis=1)
+    return jnp.concatenate([
+        il_dc.reshape(-1), il_ac.reshape(-1),
+        ic_dc.reshape(-1), ic_ac.reshape(-1), per_p.reshape(-1)])
+
+
+def _unflatten_gop(flat: np.ndarray, num_frames: int, mbw: int, mbh: int):
+    """Inverse of _gop_flat_levels on host."""
+    nmb = mbw * mbh
+    o = nmb * 16
+    il_dc = flat[:o].reshape(nmb, 16)
+    il_ac = flat[o:o + nmb * 240].reshape(nmb, 16, 15)
+    o += nmb * 240
+    ic_dc = flat[o:o + nmb * 8].reshape(nmb, 2, 4)
+    o += nmb * 8
+    ic_ac = flat[o:o + nmb * 120].reshape(nmb, 2, 4, 15)
+    o += nmb * 120
+    p = flat[o:].reshape(num_frames - 1, nmb * _P_MB) \
+        if num_frames > 1 else np.zeros((0, nmb * _P_MB), flat.dtype)
+    mv = p[:, :nmb * 2].reshape(-1, nmb, 2)
+    l16 = p[:, nmb * 2:nmb * 258].reshape(-1, nmb, 16, 16)
+    cdc = p[:, nmb * 258:nmb * 266].reshape(-1, nmb, 2, 4)
+    cac = p[:, nmb * 266:].reshape(-1, nmb, 2, 4, 15)
+    return (il_dc, il_ac, ic_dc, ic_ac), (mv, l16, cdc, cac)
+
+
+@functools.partial(jax.jit, static_argnames=("mbw", "mbh", "mesh"))
+def _encode_wave_gop(ys, us, vs, qp, *, mbw: int, mbh: int, mesh: Mesh):
+    """ys: (G, F, H, W) uint8 sharded over `gop`; each device encodes its
+    GOP as IDR + P frames (jaxinter) and sparse-packs the flat levels."""
+
+    def per_gop(y_g, u_g, v_g):
+        flat = _gop_flat_levels(y_g[0], u_g[0], v_g[0], qp, mbw, mbh)
+        return tuple(x[None] for x in jaxcore._sparse_pack(flat))
+
+    shard = jax.shard_map(
+        per_gop, mesh=mesh,
+        in_specs=(P("gop"), P("gop"), P("gop")),
+        out_specs=(P("gop"),) * 6,
+    )
+    return shard(ys, us, vs)
+
+
+@functools.partial(jax.jit, static_argnames=("mbw", "mbh", "mesh", "dtype"))
+def _encode_wave_gop_dense(ys, us, vs, qp, *, mbw: int, mbh: int, mesh: Mesh,
+                           dtype):
+    """Dense fallback for the GOP wave: (G, L) levels in `dtype`."""
+
+    def per_gop(y_g, u_g, v_g):
+        flat = _gop_flat_levels(y_g[0], u_g[0], v_g[0], qp, mbw, mbh)
+        return flat[None].astype(dtype)
+
+    shard = jax.shard_map(
+        per_gop, mesh=mesh,
+        in_specs=(P("gop"), P("gop"), P("gop")),
+        out_specs=P("gop"),
+    )
+    return shard(ys, us, vs)
+
+
 @functools.partial(jax.jit, static_argnames=("mbw", "mbh", "mesh"))
 def _encode_wave(ys, us, vs, qp, *, mbw: int, mbh: int, mesh: Mesh):
     """ys: (G, F, H, W) uint8 sharded over `gop`.
@@ -95,9 +172,13 @@ class GopShardEncoder:
     """Encode a clip as closed GOPs fanned across a device mesh."""
 
     def __init__(self, meta: VideoMeta, qp: int = 27, mesh: Mesh | None = None,
-                 gop_frames: int = 32, max_segments: int = 200):
+                 gop_frames: int = 32, max_segments: int = 200,
+                 inter: bool = True):
         self.meta = meta
         self.qp = qp
+        #: inter=True encodes each GOP as IDR + P frames (motion-coded);
+        #: False keeps the all-intra path (every frame IDR).
+        self.inter = inter
         self.mesh = mesh if mesh is not None else default_mesh()
         self.gop_frames = gop_frames
         self.max_segments = max_segments
@@ -172,42 +253,69 @@ class GopShardEncoder:
                 return
             ph, pw = ysd.shape[2], ysd.shape[3]
             mbh, mbw = ph // 16, pw // 16
-            out = _encode_wave(ysd, usd, vsd, qp, mbw=mbw, mbh=mbh,
-                               mesh=self.mesh)
+            wave_fn = _encode_wave_gop if self.inter else _encode_wave
+            out = wave_fn(ysd, usd, vsd, qp, mbw=mbw, mbh=mbh,
+                          mesh=self.mesh)
             pending.append((wave, ysd, usd, vsd, mbw, mbh, out))
 
         dispatch_next()
         while pending:
             dispatch_next()                       # overlap: depth-2 window
             wave, ysd, usd, vsd, mbw, mbh, out = pending.pop(0)
-            L = mbw * mbh * 384
+            F = ysd.shape[1]
+            nmb = mbw * mbh
+            L = (nmb * _INTRA_MB + (F - 1) * nmb * _P_MB if self.inter
+                 else nmb * _INTRA_MB)
             nnz, n_esc, bitmap, vals, esc_pos, esc_val = jax.device_get(out)
             sparse_ok = jaxcore.sparse_fits(nnz.max(), n_esc.max(), L)
             if not sparse_ok:
-                flat = jax.device_get(_encode_wave_dense(
+                dense_fn = (_encode_wave_gop_dense if self.inter
+                            else _encode_wave_dense)
+                flat = jax.device_get(dense_fn(
                     ysd, usd, vsd, qp, mbw=mbw, mbh=mbh,
                     mesh=self.mesh, dtype=jnp.int16))
             for gi, gop in enumerate(wave):
-                payload = []
-                for fi in range(gop.num_frames):
+                if self.inter:
                     if sparse_ok:
                         raw = jaxcore._sparse_unpack(
-                            int(nnz[gi, fi]), int(n_esc[gi, fi]),
-                            bitmap[gi, fi], vals[gi, fi],
-                            esc_pos[gi, fi], esc_val[gi, fi], L)
+                            int(nnz[gi]), int(n_esc[gi]), bitmap[gi],
+                            vals[gi], esc_pos[gi], esc_val[gi], L)
                     else:
-                        raw = flat[gi, fi]
-                    levels = jaxcore._unpack_levels(raw, mbw, mbh)
-                    nal = pack_slice(levels, mbw, mbh, self.sps, self.pps,
-                                     self.qp, idr=True,
-                                     idr_pic_id=(gop.start_frame + fi) % 65536)
-                    if fi == 0:
-                        nal = self.sps.to_nal() + self.pps.to_nal() + nal
-                    payload.append(nal)
+                        raw = flat[gi]
+                    payload = self._pack_gop(gop, raw, F, mbw, mbh)
+                else:
+                    payload = []
+                    for fi in range(gop.num_frames):
+                        if sparse_ok:
+                            raw = jaxcore._sparse_unpack(
+                                int(nnz[gi, fi]), int(n_esc[gi, fi]),
+                                bitmap[gi, fi], vals[gi, fi],
+                                esc_pos[gi, fi], esc_val[gi, fi], L)
+                        else:
+                            raw = flat[gi, fi]
+                        levels = jaxcore._unpack_levels(raw, mbw, mbh)
+                        nal = pack_slice(
+                            levels, mbw, mbh, self.sps, self.pps,
+                            self.qp, idr=True,
+                            idr_pic_id=(gop.start_frame + fi) % 65536)
+                        if fi == 0:
+                            nal = self.sps.to_nal() + self.pps.to_nal() + nal
+                        payload.append(nal)
                 segments.append(EncodedSegment(
                     gop=gop, payload=b"".join(payload),
                     frame_sizes=tuple(len(p) for p in payload)))
         return segments
+
+    def _pack_gop(self, gop: GopSpec, flat: np.ndarray, F: int, mbw: int,
+                  mbh: int) -> list[bytes]:
+        """Entropy-pack one GOP (IDR + P slices) from its flat levels."""
+        from ..codecs.h264.encoder import pack_gop_slices
+
+        intra, pouts = _unflatten_gop(flat.astype(np.int32), F, mbw, mbh)
+        # gop.num_frames (not F) drops the wave's tail-repeat padding.
+        return pack_gop_slices(intra, pouts, gop.num_frames, mbw, mbh,
+                               self.sps, self.pps, self.qp,
+                               idr_pic_id=gop.index)
 
     @staticmethod
     def _gop_plane(padded: list[Frame], gop: GopSpec, F: int, plane: str
@@ -220,10 +328,11 @@ class GopShardEncoder:
 
 
 def encode_clip_sharded(frames: list[Frame], meta: VideoMeta, qp: int = 27,
-                        mesh: Mesh | None = None, gop_frames: int = 32
-                        ) -> bytes:
+                        mesh: Mesh | None = None, gop_frames: int = 32,
+                        inter: bool = True) -> bytes:
     """Convenience: plan → shard encode → order-restoring concat."""
     from ..core.types import concat_segments
 
-    enc = GopShardEncoder(meta, qp=qp, mesh=mesh, gop_frames=gop_frames)
+    enc = GopShardEncoder(meta, qp=qp, mesh=mesh, gop_frames=gop_frames,
+                          inter=inter)
     return concat_segments(enc.encode(frames))
