@@ -1,0 +1,335 @@
+"""Control-plane tests: store, policy, scheduler gates, watchdog, fencing.
+
+All time-dependent behavior runs on a fake clock — the testability the
+reference never had (SURVEY.md §4: its retry/watchdog complexity existed
+precisely because it was untestable off-cluster).
+"""
+
+import numpy as np
+import pytest
+
+from thinvids_tpu.cluster import (
+    Coordinator,
+    JobStore,
+    WorkerRegistry,
+    evaluate_job_policy,
+)
+from thinvids_tpu.core.config import (
+    DEFAULT_SETTINGS,
+    Settings,
+    overlay_job_settings,
+)
+from thinvids_tpu.core.status import Status
+from thinvids_tpu.core.types import VideoMeta
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def make_settings(**over):
+    values = dict(DEFAULT_SETTINGS)
+    values.update(over)
+    return Settings(values=values)
+
+
+def make_coord(clock=None, launcher=None, workers=8, pipeline=8, **over):
+    clock = clock or FakeClock()
+    snap = make_settings(**over)
+    reg = WorkerRegistry(clock=clock)
+    for i in range(workers):
+        reg.heartbeat(f"w{i:02d}", now=clock())
+    coord = Coordinator(registry=reg, launcher=launcher, clock=clock,
+                        settings_fn=lambda: snap)
+    return coord, clock
+
+
+def meta(codec="h264", size=1 << 20):
+    return VideoMeta(width=64, height=48, num_frames=8, codec=codec,
+                     size_bytes=size)
+
+
+class TestPolicy:
+    def test_av1_toggle(self):
+        s_off = make_settings(reject_av1=False)
+        s_on = make_settings(reject_av1=True)
+        assert evaluate_job_policy(meta(codec="av1"), s_off).accepted
+        d = evaluate_job_policy(meta(codec="av1"), s_on)
+        assert not d.accepted and "av1" in d.reason
+
+    def test_large_file_behaviors(self):
+        big = meta(size=16 << 30)
+        assert not evaluate_job_policy(
+            big, make_settings(large_file_behavior="reject")).accepted
+        assert evaluate_job_policy(
+            big, make_settings(large_file_behavior="direct")
+        ).processing_mode == "direct"
+        d = evaluate_job_policy(
+            big, make_settings(large_file_behavior="nfs"))
+        assert d.processing_mode == "split" and d.scratch_mode == "nfs"
+
+    def test_vc1_forced_direct(self):
+        assert evaluate_job_policy(
+            meta(codec="vc1"), make_settings()).processing_mode == "direct"
+
+
+class TestJobStore:
+    def test_crud_and_all_idle(self):
+        store = JobStore()
+        job = store.create("/in/a.y4m", meta=meta())
+        assert store.all_idle()
+        store.update(job.id, lambda j: setattr(j, "status", Status.WAITING))
+        assert not store.all_idle()
+        assert len(store.list(Status.WAITING)) == 1
+        assert store.delete(job.id)
+        assert not store.delete(job.id)
+        with pytest.raises(KeyError):
+            store.get(job.id)
+
+    def test_snapshots_are_copies(self):
+        store = JobStore()
+        job = store.create("/in/a.y4m")
+        snap = store.get(job.id)
+        snap.status = Status.FAILED          # mutating the copy
+        assert store.get(job.id).status is Status.READY
+
+
+class TestDispatch:
+    def test_auto_start_launches(self):
+        launched = []
+        coord, _ = make_coord(launcher=launched.append)
+        job = coord.add_job("/in/a.y4m", meta())
+        assert job.status is Status.STARTING
+        assert [j.id for j in launched] == [job.id]
+        assert launched[0].run_token
+
+    def test_rejected_job_not_queued(self):
+        coord, _ = make_coord(reject_av1=True)
+        job = coord.add_job("/in/bad.av1", meta(codec="av1"))
+        assert job.status is Status.REJECTED
+        assert coord.store.all_idle()
+
+    def test_capacity_gate_blocks_second_job(self):
+        launched = []
+        coord, _ = make_coord(launcher=launched.append)
+        a = coord.add_job("/in/a.y4m", meta())
+        b = coord.add_job("/in/b.y4m", meta())
+        assert coord.store.get(a.id).status is Status.STARTING
+        assert coord.store.get(b.id).status is Status.WAITING
+        assert len(launched) == 1
+
+    def test_drain_gate_admits_second_job(self):
+        launched = []
+        coord, _ = make_coord(launcher=launched.append)
+        a = coord.add_job("/in/a.y4m", meta())
+        b = coord.add_job("/in/b.y4m", meta())
+        tok = coord.store.get(a.id).run_token
+        # a becomes RUNNING, fully segmented, 75% drained -> shareable
+        coord.mark_running(a.id, tok)
+        coord.update_progress(a.id, tok, segment_progress=100.0,
+                              parts_total=8, parts_done=6)
+        coord.dispatch_next_waiting_job()
+        assert coord.store.get(b.id).status is Status.STARTING
+        assert len(launched) == 2
+
+    def test_drain_below_ratio_blocks(self):
+        coord, _ = make_coord()
+        a = coord.add_job("/in/a.y4m", meta())
+        b = coord.add_job("/in/b.y4m", meta())
+        tok = coord.store.get(a.id).run_token
+        coord.mark_running(a.id, tok)
+        coord.update_progress(a.id, tok, segment_progress=100.0,
+                              parts_total=8, parts_done=5)   # 62.5% < 75%
+        coord.dispatch_next_waiting_job()
+        assert coord.store.get(b.id).status is Status.WAITING
+
+    def test_no_workers_no_dispatch(self):
+        coord, _ = make_coord(workers=0)
+        job = coord.add_job("/in/a.y4m", meta())
+        assert coord.store.get(job.id).status is Status.WAITING
+
+    def test_min_idle_workers_gate(self):
+        # 3 workers satisfy the slot check (3 >= 0 used + 2) but not the
+        # min-idle estimate (3 < 4), so nothing dispatches.
+        coord, _ = make_coord(workers=3, min_idle_workers=4)
+        a = coord.add_job("/in/a.y4m", meta())
+        assert coord.store.get(a.id).status is Status.WAITING
+
+    def test_stale_worker_heartbeats_expire(self):
+        launched = []
+        coord, clock = make_coord(launcher=launched.append)
+        clock.advance(60.0)          # all worker heartbeats now stale
+        job = coord.add_job("/in/a.y4m", meta())
+        assert coord.store.get(job.id).status is Status.WAITING
+        # a fresh heartbeat revives capacity
+        for i in range(8):
+            coord.registry.heartbeat(f"w{i:02d}")
+        coord.dispatch_next_waiting_job()
+        assert coord.store.get(job.id).status is Status.STARTING
+
+    def test_oldest_waiting_dispatched_first(self):
+        launched = []
+        coord, clock = make_coord(launcher=launched.append, workers=0)
+        a = coord.add_job("/in/a.y4m", meta())
+        clock.advance(1)
+        b = coord.add_job("/in/b.y4m", meta())
+        for i in range(8):
+            coord.registry.heartbeat(f"w{i:02d}")
+        coord.dispatch_next_waiting_job()
+        assert launched and launched[0].id == a.id
+        assert coord.store.get(b.id).status is Status.WAITING
+
+
+class TestFencing:
+    def test_stale_token_ignored(self):
+        coord, _ = make_coord()
+        a = coord.add_job("/in/a.y4m", meta())
+        old = coord.store.get(a.id).run_token
+        coord.restart_job(a.id)              # mints a new token
+        new = coord.store.get(a.id).run_token
+        assert old != new
+        assert not coord.update_progress(a.id, old, parts_done=3)
+        assert not coord.heartbeat_job(a.id, old, "encode")
+        assert not coord.complete_job(a.id, old, "/out/x.264", 1)
+        assert coord.update_progress(a.id, new, parts_total=4, parts_done=3)
+
+    def test_stop_revokes_token(self):
+        coord, _ = make_coord()
+        a = coord.add_job("/in/a.y4m", meta())
+        tok = coord.store.get(a.id).run_token
+        coord.stop_job(a.id)
+        assert not coord.token_is_current(a.id, tok)
+
+    def test_progress_monotonic(self):
+        coord, _ = make_coord()
+        a = coord.add_job("/in/a.y4m", meta())
+        tok = coord.store.get(a.id).run_token
+        coord.update_progress(a.id, tok, encode_progress=50.0)
+        coord.update_progress(a.id, tok, encode_progress=30.0)  # regress
+        assert coord.store.get(a.id).encode_progress == 50.0
+
+
+class TestWatchdog:
+    def test_stalled_starting_job_fails(self):
+        coord, clock = make_coord()
+        a = coord.add_job("/in/a.y4m", meta())
+        clock.advance(301.0)                 # budget 300s for STARTING
+        failed = coord.check_stalled_jobs()
+        assert [j.id for j in failed] == [a.id]
+        job = coord.store.get(a.id)
+        assert job.status is Status.FAILED
+        assert "no heartbeat" in job.failure_reason
+        assert job.run_token == ""           # revoked
+
+    def test_heartbeat_defers_watchdog(self):
+        coord, clock = make_coord()
+        a = coord.add_job("/in/a.y4m", meta())
+        tok = coord.store.get(a.id).run_token
+        clock.advance(250.0)
+        coord.heartbeat_job(a.id, tok, "segment", host="exec0")
+        clock.advance(250.0)                 # 500s total, 250s since beat
+        assert coord.check_stalled_jobs() == []
+
+    def test_running_budget_longer(self):
+        coord, clock = make_coord()
+        a = coord.add_job("/in/a.y4m", meta())
+        tok = coord.store.get(a.id).run_token
+        coord.mark_running(a.id, tok)
+        coord.heartbeat_job(a.id, tok, "encode")
+        clock.advance(400.0)                 # > STARTING 300, < RUNNING 900
+        assert coord.check_stalled_jobs() == []
+        clock.advance(600.0)
+        assert [j.id for j in coord.check_stalled_jobs()] == [a.id]
+
+    def test_watchdog_failure_redispatches_next(self):
+        launched = []
+        coord, clock = make_coord(launcher=launched.append)
+        a = coord.add_job("/in/a.y4m", meta())
+        b = coord.add_job("/in/b.y4m", meta())
+        clock.advance(301.0)
+        coord.registry  # workers stale too — revive them:
+        for i in range(8):
+            coord.registry.heartbeat(f"w{i:02d}")
+        coord.check_stalled_jobs()
+        assert coord.store.get(a.id).status is Status.FAILED
+        assert coord.store.get(b.id).status is Status.STARTING
+        assert [j.id for j in launched] == [a.id, b.id]
+
+
+class TestLifecycle:
+    def test_complete_flow(self):
+        coord, _ = make_coord()
+        a = coord.add_job("/in/a.y4m", meta())
+        tok = coord.store.get(a.id).run_token
+        coord.mark_running(a.id, tok)
+        coord.update_progress(a.id, tok, segment_progress=100.0,
+                              parts_total=4, parts_done=4,
+                              encode_progress=100.0)
+        assert coord.complete_job(a.id, tok, "/lib/a.mp4", 12345)
+        job = coord.store.get(a.id)
+        assert job.status is Status.DONE
+        assert job.output_path == "/lib/a.mp4"
+        assert coord.store.all_idle()
+
+    def test_executor_fail_attribution(self):
+        coord, _ = make_coord()
+        a = coord.add_job("/in/a.y4m", meta())
+        tok = coord.store.get(a.id).run_token
+        coord.fail_job(a.id, tok, stage="encode", host="exec1",
+                       reason="part 3 failed 5 times")
+        job = coord.store.get(a.id)
+        assert job.status is Status.FAILED
+        assert job.failure_stage == "encode"
+        assert job.failure_host == "exec1"
+
+    def test_restart_after_failure(self):
+        coord, _ = make_coord()
+        a = coord.add_job("/in/a.y4m", meta())
+        tok = coord.store.get(a.id).run_token
+        coord.fail_job(a.id, tok, "encode", "exec1", "boom")
+        job = coord.restart_job(a.id)
+        assert job.status is Status.STARTING     # re-dispatched
+        assert job.failure_reason == ""
+        assert job.run_token and job.run_token != tok
+
+    def test_activity_log_wired(self):
+        coord, _ = make_coord()
+        a = coord.add_job("/in/a.y4m", meta())
+        events = coord.activity.fetch()
+        assert any(e["stage"] == "dispatch" for e in events)
+        lines = coord.activity.fetch_job(a.id)
+        assert lines
+
+
+class TestRegistry:
+    def test_role_assignment_natural_sort(self):
+        reg = WorkerRegistry(clock=lambda: 0.0)
+        for h in ("w10", "w2", "w1"):
+            reg.heartbeat(h, now=0.0)
+        roles = reg.assign_roles(2)
+        assert roles == {"w1": "pipeline", "w2": "pipeline",
+                         "w10": "encode"}
+
+    def test_disabled_workers_excluded(self):
+        clock = FakeClock()
+        reg = WorkerRegistry(clock=clock)
+        reg.heartbeat("a")
+        reg.heartbeat("b")
+        reg.set_disabled("a", True, reason="flaky")
+        assert [w.host for w in reg.active(15.0)] == ["b"]
+        assert reg.assign_roles(2) == {"b": "pipeline"}
+
+    def test_job_settings_overlay(self):
+        coord, _ = make_coord()
+        a = coord.add_job("/in/a.y4m", meta(), settings={"qp": 40,
+                                                        "bogus": 1})
+        snap = coord.job_settings(coord.store.get(a.id))
+        assert snap.qp == 40
+        assert "bogus" not in snap.values

@@ -1,0 +1,93 @@
+"""MP4 muxer tests: structural parse + external decodability via cv2
+(opencv bundles ffmpeg — the de-facto container conformance oracle)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from thinvids_tpu.codecs.h264.encoder import encode_gop
+from thinvids_tpu.core.types import Frame, VideoMeta
+from thinvids_tpu.io.mp4 import annexb_to_samples, mux_mp4, split_annexb
+
+
+def clip(w=64, h=48, n=6):
+    yy, xx = np.mgrid[0:h, 0:w]
+    return [Frame(
+        y=((xx + yy * 2 + 5 * i) % 256).astype(np.uint8),
+        u=np.full((h // 2, w // 2), 110, np.uint8),
+        v=np.full((h // 2, w // 2), 140, np.uint8),
+    ) for i in range(n)]
+
+
+def toplevel_boxes(data):
+    boxes = []
+    i = 0
+    while i < len(data):
+        size = struct.unpack(">I", data[i:i + 4])[0]
+        boxes.append(data[i + 4:i + 8].decode())
+        i += size
+    return boxes
+
+
+class TestMux:
+    def test_annexb_split_and_samples(self):
+        meta = VideoMeta(width=64, height=48, fps_num=30, fps_den=1)
+        stream = encode_gop(clip(), meta, qp=30)
+        sps, pps, samples, keys = annexb_to_samples(stream)
+        assert sps[0] & 0x1F == 7 and pps[0] & 0x1F == 8
+        assert len(samples) == 6
+        assert keys == [True] + [False] * 5     # IDR + 5 P
+        nals = split_annexb(stream)
+        assert len(nals) == 8                   # SPS PPS IDR 5xP
+
+    def test_faststart_layout_and_structure(self):
+        meta = VideoMeta(width=64, height=48, fps_num=30, fps_den=1)
+        stream = encode_gop(clip(), meta, qp=30)
+        mp4 = mux_mp4(stream, meta)
+        assert toplevel_boxes(mp4) == ["ftyp", "moov", "mdat"]
+        # chunk offset points at the first sample inside mdat
+        # box: [size][`stco`][ver/flags][count][offset0]
+        stco_at = mp4.find(b"stco")
+        off = struct.unpack(">I", mp4[stco_at + 12:stco_at + 16])[0]
+        first_len = struct.unpack(">I", mp4[off:off + 4])[0]
+        assert mp4[off + 4] & 0x1F == 5         # IDR NAL right there
+        assert first_len > 0
+
+    def test_cv2_decodes_mp4(self, tmp_path):
+        import cv2
+
+        w, h, n = 64, 48, 8
+        meta = VideoMeta(width=w, height=h, fps_num=25, fps_den=1)
+        stream = encode_gop(clip(w, h, n), meta, qp=28)
+        path = str(tmp_path / "out.mp4")
+        open(path, "wb").write(mux_mp4(stream, meta))
+        cap = cv2.VideoCapture(path)
+        assert int(cap.get(cv2.CAP_PROP_FRAME_COUNT)) == n
+        assert int(cap.get(cv2.CAP_PROP_FRAME_WIDTH)) == w
+        assert int(cap.get(cv2.CAP_PROP_FRAME_HEIGHT)) == h
+        assert abs(cap.get(cv2.CAP_PROP_FPS) - 25.0) < 0.01
+        count = 0
+        while True:
+            ok, img = cap.read()
+            if not ok:
+                break
+            assert img.shape[:2] == (h, w)
+            count += 1
+        assert count == n
+
+    def test_cropped_dims_in_container(self, tmp_path):
+        import cv2
+
+        w, h = 70, 50
+        meta = VideoMeta(width=w, height=h, fps_num=30, fps_den=1)
+        stream = encode_gop(clip(w, h, 4), meta, qp=30)
+        path = str(tmp_path / "crop.mp4")
+        open(path, "wb").write(mux_mp4(stream, meta))
+        cap = cv2.VideoCapture(path)
+        ok, img = cap.read()
+        assert ok and img.shape[:2] == (h, w)
+
+    def test_no_parameter_sets_raises(self):
+        with pytest.raises(ValueError, match="SPS/PPS"):
+            mux_mp4(b"\x00\x00\x01\x65\x88", VideoMeta(width=16, height=16))

@@ -1,0 +1,25 @@
+"""Cluster control plane: job store, admission policy, coordinator.
+
+The reference ran this layer as a Flask manager over Redis state with a
+Huey task queue (/root/reference/manager/app.py); here it is an
+in-process coordinator designed for a TPU-VM host: the "fleet" is a set
+of executors (device-mesh owners) instead of thin clients, the job store
+is typed instead of a ~60-field Redis hash, and dispatch hands GOP-wave
+work to executors instead of enqueuing ffmpeg tasks. The concurrency
+semantics — capacity-gated admission with drain ratios, run-token
+fencing, heartbeat watchdogs, part-level retries — are ports of the
+reference's (SURVEY.md §2.3, §5.3).
+"""
+
+from .jobs import Job, JobStore
+from .policy import PolicyDecision, evaluate_job_policy
+from .coordinator import Coordinator, WorkerRegistry
+
+__all__ = [
+    "Coordinator",
+    "Job",
+    "JobStore",
+    "PolicyDecision",
+    "WorkerRegistry",
+    "evaluate_job_policy",
+]

@@ -1,0 +1,478 @@
+"""Coordinator: worker liveness, capacity-gated scheduling, watchdog.
+
+Semantics ported from the reference manager's background threads
+(/root/reference/manager/app.py:986-1516):
+
+- **Liveness**: workers (executor processes owning a device mesh — the
+  analog of thin-client nodes) heartbeat into a registry; active =
+  heartbeat within the metrics TTL. Roles mirror the reference's
+  pipeline/encode split (/root/reference/manager/app.py:105-148).
+- **Admission**: a WAITING job is dispatched only when every active job
+  is "shareable" (RUNNING, segmentation done, encode drain >= ratio),
+  slot accounting leaves headroom (STARTING or segmenting jobs hold 2
+  slots = master+stitcher analog, draining jobs hold 1), and enough
+  idle workers remain (/root/reference/manager/app.py:1072-1133).
+- **Fencing**: each dispatch mints a run token; executor callbacks that
+  present a stale token are ignored
+  (/root/reference/worker/tasks.py:396-424).
+- **Watchdog**: active jobs whose heartbeat goes stale past the
+  per-stage budget are failed with stage/host attribution and the next
+  job is dispatched (/root/reference/manager/app.py:1379-1472).
+
+The scheduler lock is an in-process RLock (the reference needed a Redis
+SET NX EX lock because several gunicorn workers raced; a single
+coordinator process needs only mutual exclusion between its threads).
+Time is injected (`clock`) so every budget is testable with a fake
+clock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import threading
+import time
+from typing import Any, Callable, Mapping
+
+from ..core.config import Settings, get_settings, overlay_job_settings
+from ..core.events import ActivityLog
+from ..core.status import Status
+from ..core.types import VideoMeta
+from .jobs import Job, JobStore, new_run_token
+from .policy import evaluate_job_policy
+
+
+def natural_key(host: str) -> tuple:
+    """Numeric-aware host sort (the reference's natural_key,
+    /root/reference/common.py:163-166)."""
+    return tuple(int(p) if p.isdigit() else p
+                 for p in re.split(r"(\d+)", host))
+
+
+@dataclasses.dataclass
+class WorkerInfo:
+    host: str
+    role: str = "encode"            # pipeline | encode
+    last_seen: float = 0.0
+    disabled: bool = False
+    quarantine_reason: str = ""
+    metrics: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+class WorkerRegistry:
+    """Executor liveness registry (the analog of `nodes:mac` +
+    `metrics:node:*` TTL liveness, /root/reference/agent/agent.py:417-436
+    and manager/app.py:42-102)."""
+
+    def __init__(self, clock: Callable[[], float] = time.time) -> None:
+        self._lock = threading.Lock()
+        self._workers: dict[str, WorkerInfo] = {}
+        self._clock = clock
+
+    def heartbeat(self, host: str, metrics: Mapping[str, Any] | None = None,
+                  now: float | None = None) -> None:
+        now = self._clock() if now is None else now
+        with self._lock:
+            info = self._workers.setdefault(host, WorkerInfo(host=host))
+            info.last_seen = now
+            if metrics:
+                info.metrics = dict(metrics)
+
+    def assign_roles(self, pipeline_count: int) -> dict[str, str]:
+        """First `pipeline_count` enabled hosts (natural sort) take the
+        pipeline role, the rest encode
+        (/root/reference/manager/app.py:105-148)."""
+        with self._lock:
+            hosts = sorted(
+                (h for h, w in self._workers.items() if not w.disabled),
+                key=natural_key)
+            roles = {}
+            for i, host in enumerate(hosts):
+                role = "pipeline" if i < pipeline_count else "encode"
+                self._workers[host].role = role
+                roles[host] = role
+            return roles
+
+    def active(self, ttl_s: float, now: float | None = None
+               ) -> list[WorkerInfo]:
+        now = self._clock() if now is None else now
+        with self._lock:
+            return [dataclasses.replace(w) for w in self._workers.values()
+                    if not w.disabled and now - w.last_seen <= ttl_s]
+
+    def all(self) -> list[WorkerInfo]:
+        with self._lock:
+            return [dataclasses.replace(w) for w in self._workers.values()]
+
+    def set_disabled(self, host: str, disabled: bool,
+                     reason: str = "") -> None:
+        with self._lock:
+            info = self._workers.setdefault(host, WorkerInfo(host=host))
+            info.disabled = disabled
+            info.quarantine_reason = reason if disabled else ""
+
+    def delete(self, host: str) -> bool:
+        with self._lock:
+            return self._workers.pop(host, None) is not None
+
+
+# Jobs in these states occupy scheduler slots.
+_SLOTS_SEGMENTING = 2      # master + stitcher analog
+_SLOTS_DRAINING = 1        # stitcher only
+
+
+class Coordinator:
+    """Single-process control plane over a JobStore + WorkerRegistry."""
+
+    def __init__(self, store: JobStore | None = None,
+                 registry: WorkerRegistry | None = None,
+                 launcher: Callable[[Job], None] | None = None,
+                 activity: ActivityLog | None = None,
+                 clock: Callable[[], float] = time.time,
+                 settings_fn: Callable[[], Settings] = get_settings) -> None:
+        self.store = store if store is not None else JobStore()
+        self.registry = registry if registry is not None else WorkerRegistry(
+            clock=clock)
+        self.activity = activity if activity is not None else ActivityLog()
+        self._launcher = launcher
+        self._clock = clock
+        self._settings_fn = settings_fn
+        self._sched_lock = threading.RLock()
+        self._active_ids: set[str] = set()
+
+    # ---- job registration / lifecycle --------------------------------
+
+    def add_job(self, input_path: str, meta: VideoMeta,
+                settings: Mapping[str, Any] | None = None,
+                auto_start: bool | None = None) -> Job:
+        """Register a job: admission policy → READY/REJECTED; optionally
+        queue + dispatch (the reference's POST /add_job,
+        /root/reference/manager/app.py:2222-2400)."""
+        snap = self._settings_fn()
+        decision = evaluate_job_policy(meta, snap)
+        job = self.store.create(input_path, meta=meta, settings=settings)
+        if not decision.accepted:
+            job = self.store.update(job.id, lambda j: (
+                setattr(j, "status", Status.REJECTED),
+                setattr(j, "reject_reason", decision.reason)))
+            self.activity.emit("reject", f"rejected: {decision.reason}",
+                               job_id=job.id)
+            return job
+
+        def apply(j: Job) -> None:
+            j.processing_mode = decision.processing_mode
+        job = self.store.update(job.id, apply)
+        self.activity.emit("start", f"registered {input_path}",
+                           job_id=job.id)
+        if auto_start if auto_start is not None else snap.auto_start_jobs:
+            self.queue_job(job.id)
+            self.dispatch_next_waiting_job()
+        return self.store.get(job.id)
+
+    def queue_job(self, job_id: str) -> Job:
+        now = self._clock()
+
+        def apply(j: Job) -> None:
+            if j.status.is_active:
+                raise ValueError(f"job {j.id} is {j.status.value}")
+            j.status = Status.WAITING
+            j.queued_at = now
+        job = self.store.update(job_id, apply)
+        self.activity.emit("queue", "queued for dispatch", job_id=job_id)
+        return job
+
+    def stop_job(self, job_id: str) -> Job:
+        def apply(j: Job) -> None:
+            j.status = Status.STOPPED
+            j.run_token = ""            # fences out in-flight executors
+        job = self.store.update(job_id, apply)
+        with self._sched_lock:
+            self._active_ids.discard(job_id)
+        self.activity.emit("stop", "stopped by operator", job_id=job_id)
+        return job
+
+    def restart_job(self, job_id: str) -> Job:
+        """Wipe run state and requeue (the reference's /restart_job,
+        /root/reference/manager/app.py:2501-2666)."""
+        def apply(j: Job) -> None:
+            j.run_token = ""
+            j.segment_progress = 0.0
+            j.encode_progress = 0.0
+            j.combine_progress = 0.0
+            j.parts_total = 0
+            j.parts_done = 0
+            j.heartbeat_at = 0.0
+            j.heartbeat_stage = ""
+            j.heartbeat_host = ""
+            j.heartbeat_note = ""
+            j.failure_stage = ""
+            j.failure_host = ""
+            j.failure_reason = ""
+            j.output_path = ""
+            j.output_bytes = 0
+            j.started_at = 0.0
+            j.finished_at = 0.0
+            j.status = Status.READY
+        self.store.update(job_id, apply)
+        with self._sched_lock:
+            self._active_ids.discard(job_id)
+        job = self.queue_job(job_id)
+        self.dispatch_next_waiting_job()
+        return self.store.get(job_id)
+
+    def delete_job(self, job_id: str) -> bool:
+        with self._sched_lock:
+            self._active_ids.discard(job_id)
+        self.activity.drop_job(job_id)
+        return self.store.delete(job_id)
+
+    # ---- executor-facing callbacks (token-fenced) --------------------
+
+    def token_is_current(self, job_id: str, token: str) -> bool:
+        job = self.store.try_get(job_id)
+        return job is not None and bool(token) and job.run_token == token
+
+    def heartbeat_job(self, job_id: str, token: str, stage: str,
+                      host: str = "", note: str = "") -> bool:
+        """Throttled heartbeat write (the reference's _job_heartbeat,
+        /root/reference/worker/tasks.py:88-123). Returns False when
+        fenced out (stale token)."""
+        if not self.token_is_current(job_id, token):
+            return False
+        now = self._clock()
+        throttle = float(self._settings_fn().heartbeat_throttle_s)
+
+        def apply(j: Job) -> None:
+            if now - j.heartbeat_at < throttle and j.heartbeat_stage == stage:
+                return
+            j.heartbeat_at = now
+            j.heartbeat_stage = stage
+            j.heartbeat_host = host
+            j.heartbeat_note = note
+        self.store.update(job_id, apply)
+        return True
+
+    def update_progress(self, job_id: str, token: str, **fields: Any) -> bool:
+        """Progress fields from executors; stale tokens are ignored."""
+        if not self.token_is_current(job_id, token):
+            return False
+        allowed = {"segment_progress", "encode_progress", "combine_progress",
+                   "parts_total", "parts_done"}
+        bad = set(fields) - allowed
+        if bad:
+            raise ValueError(f"unknown progress fields {sorted(bad)}")
+
+        def apply(j: Job) -> None:
+            for k, v in fields.items():
+                # progress is monotonic per run (reference kept monotonic
+                # encode_progress, /root/reference/worker/tasks.py:1704-1719)
+                if k.endswith("_progress"):
+                    v = max(float(v), getattr(j, k))
+                setattr(j, k, v)
+        self.store.update(job_id, apply)
+        return True
+
+    def mark_running(self, job_id: str, token: str) -> bool:
+        if not self.token_is_current(job_id, token):
+            return False
+
+        def apply(j: Job) -> None:
+            j.status = Status.RUNNING
+        self.store.update(job_id, apply)
+        return True
+
+    def complete_job(self, job_id: str, token: str, output_path: str,
+                     output_bytes: int) -> bool:
+        if not self.token_is_current(job_id, token):
+            return False
+        now = self._clock()
+
+        def apply(j: Job) -> None:
+            j.status = Status.DONE
+            j.finished_at = now
+            j.elapsed_s = now - j.started_at if j.started_at else 0.0
+            j.output_path = output_path
+            j.output_bytes = output_bytes
+            j.combine_progress = 100.0
+        self.store.update(job_id, apply)
+        with self._sched_lock:
+            self._active_ids.discard(job_id)
+        self.activity.emit("finish", f"done → {output_path}", job_id=job_id)
+        self.dispatch_next_waiting_job()
+        return True
+
+    def fail_job(self, job_id: str, token: str, stage: str, host: str,
+                 reason: str) -> bool:
+        """Executor-reported failure (retry budget exhausted)."""
+        if token and not self.token_is_current(job_id, token):
+            return False
+        self._fail(job_id, stage, host, reason)
+        self.dispatch_next_waiting_job()
+        return True
+
+    def _fail(self, job_id: str, stage: str, host: str, reason: str) -> None:
+        now = self._clock()
+
+        def apply(j: Job) -> None:
+            j.status = Status.FAILED
+            j.finished_at = now
+            j.run_token = ""            # revoke: fence out stragglers
+            j.failure_stage = stage
+            j.failure_host = host
+            j.failure_reason = reason
+        self.store.update(job_id, apply)
+        with self._sched_lock:
+            self._active_ids.discard(job_id)
+        self.activity.emit("error", f"failed in {stage}: {reason}",
+                           job_id=job_id, host=host)
+
+    # ---- scheduler (capacity-gated dispatch) -------------------------
+
+    def job_settings(self, job: Job) -> Settings:
+        return overlay_job_settings(self._settings_fn(), job.settings)
+
+    def _active_jobs_locked(self) -> list[Job]:
+        """Resolve the active set, adopting orphaned active-status jobs
+        and dropping finished ones (the reference's adoption pass,
+        /root/reference/manager/app.py:1014-1041)."""
+        active: list[Job] = []
+        seen: set[str] = set()
+        for job in self.store.list():
+            if job.status.is_active:
+                self._active_ids.add(job.id)
+                seen.add(job.id)
+                active.append(job)
+        self._active_ids &= seen
+        return active
+
+    def _job_slots(self, job: Job) -> int:
+        if job.status is Status.STARTING or job.segment_progress < 100.0:
+            return _SLOTS_SEGMENTING
+        return _SLOTS_DRAINING
+
+    def _job_is_shareable(self, job: Job, drain_ratio: float) -> bool:
+        """A job tolerates a new neighbor once it is RUNNING, fully
+        segmented, and mostly drained
+        (/root/reference/manager/app.py:1072-1086)."""
+        return (job.status is Status.RUNNING
+                and job.segment_progress >= 100.0
+                and job.done_ratio >= drain_ratio)
+
+    def _can_dispatch_locked(self, active: list[Job], snap: Settings,
+                             now: float) -> tuple[bool, str]:
+        if len(active) >= snap.effective_max_active_jobs():
+            return False, "max active jobs reached"
+        drain = float(snap.drain_ratio)
+        for job in active:
+            if not self._job_is_shareable(job, drain):
+                return False, f"job {job.id[:8]} not shareable yet"
+        self.registry.assign_roles(int(snap.pipeline_worker_count))
+        workers = self.registry.active(float(snap.metrics_ttl_s), now=now)
+        pipeline_workers = [w for w in workers if w.role == "pipeline"]
+        used = sum(self._job_slots(j) for j in active)
+        if len(pipeline_workers) < used + _SLOTS_SEGMENTING:
+            return False, "no free pipeline slots"
+        idle_estimate = len(workers) - used
+        if idle_estimate < int(snap.min_idle_workers):
+            return False, "not enough idle workers"
+        return True, ""
+
+    def dispatch_next_waiting_job(self) -> Job | None:
+        """One scheduler pass: reserve the oldest WAITING job when the
+        capacity gate passes, then launch it outside the lock
+        (/root/reference/manager/app.py:1296-1310)."""
+        now = self._clock()
+        snap = self._settings_fn()
+        with self._sched_lock:
+            active = self._active_jobs_locked()
+            ok, _why = self._can_dispatch_locked(active, snap, now)
+            if not ok:
+                return None
+            waiting = self.store.list(Status.WAITING)
+            if not waiting:
+                return None
+            chosen = min(waiting, key=lambda j: j.queued_at or j.created_at)
+            token = new_run_token()
+
+            def reserve(j: Job) -> None:
+                j.status = Status.STARTING
+                j.run_token = token
+                j.started_at = now
+                j.heartbeat_at = now
+                j.heartbeat_stage = "reserve"
+            job = self.store.update(chosen.id, reserve)
+            self._active_ids.add(job.id)
+        self.activity.emit("dispatch", "reserved for launch", job_id=job.id)
+        if self._launcher is not None:
+            self._launcher(job)
+        return job
+
+    # ---- watchdog ----------------------------------------------------
+
+    _STALL_BUDGETS = {
+        Status.STARTING: "stall_starting_s",
+        Status.RUNNING: "stall_running_s",
+        Status.STAMPING: "stall_stamping_s",
+    }
+
+    def check_stalled_jobs(self) -> list[Job]:
+        """Fail active jobs whose heartbeat exceeded the per-stage budget
+        (/root/reference/manager/app.py:1379-1472). Returns failed jobs."""
+        now = self._clock()
+        snap = self._settings_fn()
+        failed: list[Job] = []
+        with self._sched_lock:
+            active = self._active_jobs_locked()
+        for job in active:
+            budget_key = self._STALL_BUDGETS.get(job.status)
+            if budget_key is None:
+                continue
+            budget = float(snap.get(budget_key))
+            last = max(job.heartbeat_at, job.started_at)
+            if last and now - last > budget:
+                self._fail(
+                    job.id, stage=job.heartbeat_stage or job.status.value,
+                    host=job.heartbeat_host,
+                    reason=(f"no heartbeat for {now - last:.0f}s "
+                            f"(budget {budget:.0f}s)"))
+                failed.append(self.store.get(job.id))
+        if failed:
+            self.dispatch_next_waiting_job()
+        return failed
+
+    # ---- background loops (threads; logic above stays tick-testable) --
+
+    def start_background(self) -> list[threading.Thread]:
+        """Spawn the scheduler + watchdog poll loops (the reference's
+        daemon threads, /root/reference/manager/app.py:1474-1516)."""
+        snap = self._settings_fn()
+        self._stop = threading.Event()
+
+        def scheduler_loop() -> None:
+            while not self._stop.wait(float(snap.scheduler_poll_s)):
+                try:
+                    self.dispatch_next_waiting_job()
+                except Exception:   # pragma: no cover - keep loop alive
+                    pass
+
+        def watchdog_loop() -> None:
+            while not self._stop.wait(float(snap.watchdog_poll_s)):
+                try:
+                    self.check_stalled_jobs()
+                except Exception:   # pragma: no cover - keep loop alive
+                    pass
+
+        threads = [
+            threading.Thread(target=scheduler_loop, daemon=True,
+                             name="tvt-scheduler"),
+            threading.Thread(target=watchdog_loop, daemon=True,
+                             name="tvt-watchdog"),
+        ]
+        for t in threads:
+            t.start()
+        return threads
+
+    def stop_background(self) -> None:
+        stop = getattr(self, "_stop", None)
+        if stop is not None:
+            stop.set()

@@ -1,0 +1,152 @@
+"""Typed job records + thread-safe store.
+
+The reference kept each job as a ~60-field Redis hash (`job:<uuid>`,
+/root/reference/manager/app.py:2367-2370) indexed by a `jobs:all` set
+(/root/reference/common.py:231-274); this is the typed in-process
+equivalent with the same lifecycle fields: status, per-stage progress,
+run-token fence, heartbeat triple, and failure attribution.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import uuid
+from typing import Any, Callable, Iterator, Mapping
+
+from ..core.status import Status
+from ..core.types import VideoMeta
+
+
+def new_run_token() -> str:
+    """Fencing token minted per dispatch; stale executors no-op when
+    their token no longer matches (the reference's pipeline_run_token,
+    /root/reference/worker/tasks.py:396-424)."""
+    return uuid.uuid4().hex
+
+
+@dataclasses.dataclass
+class Job:
+    """One transcode job. Mutate only through JobStore.update()."""
+
+    id: str
+    input_path: str
+    meta: VideoMeta | None = None
+    status: Status = Status.READY
+    # settings overlay (core.config.JOB_SETTING_KEYS subset)
+    settings: dict[str, Any] = dataclasses.field(default_factory=dict)
+    # admission decision
+    processing_mode: str = "split"       # split | direct
+    reject_reason: str = ""
+    # scheduling / fencing
+    run_token: str = ""
+    queued_at: float = 0.0
+    started_at: float = 0.0
+    finished_at: float = 0.0
+    created_at: float = dataclasses.field(default_factory=time.time)
+    # progress (percent 0-100, parts = GOP segments)
+    segment_progress: float = 0.0
+    encode_progress: float = 0.0
+    combine_progress: float = 0.0
+    parts_total: int = 0
+    parts_done: int = 0
+    # heartbeat (throttled writes; watchdog liveness source)
+    heartbeat_at: float = 0.0
+    heartbeat_stage: str = ""
+    heartbeat_host: str = ""
+    heartbeat_note: str = ""
+    # failure attribution
+    failure_stage: str = ""
+    failure_host: str = ""
+    failure_reason: str = ""
+    # result
+    output_path: str = ""
+    output_bytes: int = 0
+    elapsed_s: float = 0.0
+
+    @property
+    def done_ratio(self) -> float:
+        if self.parts_total <= 0:
+            return 0.0
+        return self.parts_done / self.parts_total
+
+    def to_dict(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["status"] = self.status.value
+        if self.meta is not None:
+            d["meta"] = dataclasses.asdict(self.meta)
+        return d
+
+
+class JobStore:
+    """Thread-safe in-process job index.
+
+    The update() path takes the store lock and hands the caller the live
+    record — the analog of the reference's HSET read-modify-write under
+    its scheduler lock. Snapshots returned by get()/list() are copies.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._jobs: dict[str, Job] = {}
+
+    def create(self, input_path: str, meta: VideoMeta | None = None,
+               settings: Mapping[str, Any] | None = None,
+               job_id: str | None = None) -> Job:
+        job = Job(id=job_id or uuid.uuid4().hex, input_path=input_path,
+                  meta=meta, settings=dict(settings or {}))
+        with self._lock:
+            if job.id in self._jobs:
+                raise ValueError(f"duplicate job id {job.id}")
+            self._jobs[job.id] = job
+        return self.get(job.id)
+
+    def get(self, job_id: str) -> Job:
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None:
+                raise KeyError(f"no such job {job_id}")
+            return dataclasses.replace(job)
+
+    def try_get(self, job_id: str) -> Job | None:
+        try:
+            return self.get(job_id)
+        except KeyError:
+            return None
+
+    def update(self, job_id: str, fn: Callable[[Job], None]) -> Job:
+        """Apply `fn` to the live record under the store lock; returns a
+        snapshot of the result."""
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None:
+                raise KeyError(f"no such job {job_id}")
+            fn(job)
+            return dataclasses.replace(job)
+
+    def delete(self, job_id: str) -> bool:
+        with self._lock:
+            return self._jobs.pop(job_id, None) is not None
+
+    def list(self, status: Status | None = None) -> list[Job]:
+        with self._lock:
+            jobs = [dataclasses.replace(j) for j in self._jobs.values()]
+        if status is not None:
+            jobs = [j for j in jobs if j.status is status]
+        return sorted(jobs, key=lambda j: j.created_at)
+
+    def __iter__(self) -> Iterator[Job]:
+        return iter(self.list())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._jobs)
+
+    def all_idle(self) -> bool:
+        """True iff no job is WAITING or active (the reference's
+        all_jobs_are_idle, /root/reference/common.py:231-274)."""
+        with self._lock:
+            return not any(
+                j.status is Status.WAITING or j.status.is_active
+                for j in self._jobs.values())

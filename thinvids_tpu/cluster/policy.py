@@ -1,0 +1,53 @@
+"""Job admission policy.
+
+Port of the reference's `_evaluate_job_policy`
+(/root/reference/manager/app.py:872-917): decide at registration time
+whether a job is rejected, runs in split (segmented) mode, or direct
+mode, based on codec and size. The TPU build inverts one rule: the
+reference REJECTED AV1 input because its fleet couldn't decode it;
+here AV1 rejection is a toggle that defaults off.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..core.config import Settings
+from ..core.types import VideoMeta
+
+# Codecs whose long-GOP/interlace quirks made stream-copy segmentation
+# unreliable in the reference — forced to direct (whole-file) mode
+# (/root/reference/manager/app.py:898-903).
+DIRECT_ONLY_CODECS = frozenset({"vc1", "wmv3"})
+
+
+@dataclasses.dataclass(frozen=True)
+class PolicyDecision:
+    accepted: bool
+    processing_mode: str = "split"     # split | direct
+    scratch_mode: str = "local"        # local | nfs
+    reason: str = ""                   # rejection reason when not accepted
+
+
+def evaluate_job_policy(meta: VideoMeta, settings: Settings) -> PolicyDecision:
+    codec = (meta.codec or "").lower()
+
+    if settings.reject_av1 and codec == "av1":
+        return PolicyDecision(accepted=False, reason="av1 input rejected")
+
+    large_bytes = float(settings.large_file_gb) * (1 << 30)
+    if meta.size_bytes and meta.size_bytes > large_bytes:
+        behavior = settings.large_file_behavior
+        if behavior == "reject":
+            return PolicyDecision(
+                accepted=False,
+                reason=f"file exceeds {settings.large_file_gb:g} GB")
+        if behavior == "nfs":
+            return PolicyDecision(accepted=True, processing_mode="split",
+                                  scratch_mode="nfs")
+        return PolicyDecision(accepted=True, processing_mode="direct")
+
+    if codec in DIRECT_ONLY_CODECS:
+        return PolicyDecision(accepted=True, processing_mode="direct")
+
+    return PolicyDecision(accepted=True)

@@ -1,0 +1,164 @@
+"""Minimal ISO-BMFF (MP4) muxer for H.264 elementary streams.
+
+The reference delivered playable MP4s by shelling out to
+`ffmpeg -f concat -c copy -movflags +faststart`
+(/root/reference/worker/tasks.py:2100-2131); this is the in-framework
+equivalent: Annex-B in, faststart MP4 out (moov before mdat). One video
+track, avc1 + avcC, one chunk, constant frame rate, stss marking IDR
+sync samples.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterable
+
+from ..core.types import VideoMeta
+
+_NAL_SPS, _NAL_PPS, _NAL_SEI, _NAL_AUD = 7, 8, 6, 9
+_NAL_IDR = 5
+
+
+def split_annexb(stream: bytes) -> list[bytes]:
+    """Split an Annex-B byte stream into raw NAL units (no start codes)."""
+    nals = []
+    i = 0
+    n = len(stream)
+    while i < n:
+        # find next start code (3- or 4-byte)
+        j = stream.find(b"\x00\x00\x01", i)
+        if j < 0:
+            break
+        start = j + 3
+        k = stream.find(b"\x00\x00\x01", start)
+        end = n if k < 0 else (k - 1 if k > 0 and stream[k - 1] == 0 else k)
+        nal = stream[start:end]
+        while nal.endswith(b"\x00"):        # trailing zero padding
+            nal = nal[:-1]
+        if nal:
+            nals.append(nal)
+        i = start if k < 0 else k
+        if k < 0:
+            break
+    return nals
+
+
+def annexb_to_samples(stream: bytes
+                      ) -> tuple[bytes, bytes, list[bytes], list[bool]]:
+    """(sps, pps, samples, keyflags): AVCC length-prefixed samples, one
+    per coded picture (this encoder emits one slice per picture)."""
+    sps = b""
+    pps = b""
+    samples: list[bytes] = []
+    keyflags: list[bool] = []
+    for nal in split_annexb(stream):
+        ntype = nal[0] & 0x1F
+        if ntype == _NAL_SPS:
+            sps = sps or nal
+        elif ntype == _NAL_PPS:
+            pps = pps or nal
+        elif ntype in (_NAL_SEI, _NAL_AUD):
+            continue
+        elif ntype in (1, _NAL_IDR):
+            samples.append(struct.pack(">I", len(nal)) + nal)
+            keyflags.append(ntype == _NAL_IDR)
+    if not sps or not pps:
+        raise ValueError("stream has no SPS/PPS")
+    return sps, pps, samples, keyflags
+
+
+def _box(kind: bytes, *payload: bytes) -> bytes:
+    body = b"".join(payload)
+    return struct.pack(">I", 8 + len(body)) + kind + body
+
+
+def _full(kind: bytes, version: int, flags: int, *payload: bytes) -> bytes:
+    return _box(kind, struct.pack(">I", (version << 24) | flags), *payload)
+
+
+def _avcc(sps: bytes, pps: bytes) -> bytes:
+    cfg = bytes([1, sps[1], sps[2], sps[3], 0xFF, 0xE1])
+    cfg += struct.pack(">H", len(sps)) + sps
+    cfg += bytes([1]) + struct.pack(">H", len(pps)) + pps
+    return _box(b"avcC", cfg)
+
+
+def _matrix() -> bytes:
+    return struct.pack(">9I", 0x10000, 0, 0, 0, 0x10000, 0, 0, 0, 0x40000000)
+
+
+def mux_mp4(stream: bytes, meta: VideoMeta) -> bytes:
+    """Annex-B H.264 elementary stream → faststart MP4 bytes."""
+    sps, pps, samples, keys = annexb_to_samples(stream)
+    n = len(samples)
+    if n == 0:
+        raise ValueError("no coded pictures in stream")
+    timescale = 90000
+    sample_dur = timescale * meta.fps_den // max(1, meta.fps_num)
+    duration = sample_dur * n
+    w, h = meta.width, meta.height
+
+    ftyp = _box(b"ftyp", b"isom", struct.pack(">I", 0x200),
+                b"isomiso2avc1mp41")
+
+    stsd = _full(b"stsd", 0, 0, struct.pack(">I", 1), _box(
+        b"avc1",
+        b"\x00" * 6, struct.pack(">H", 1),            # reserved + dref idx
+        b"\x00" * 16,
+        struct.pack(">HH", w, h),
+        struct.pack(">II", 0x480000, 0x480000),       # 72 dpi
+        b"\x00" * 4,
+        struct.pack(">H", 1),                         # frame count
+        b"\x00" * 32,                                 # compressor name
+        struct.pack(">Hh", 0x18, -1),                 # depth, color table
+        _avcc(sps, pps),
+    ))
+    stts = _full(b"stts", 0, 0, struct.pack(">III", 1, n, sample_dur))
+    stsc = _full(b"stsc", 0, 0, struct.pack(">IIII", 1, 1, n, 1))
+    stsz = _full(b"stsz", 0, 0, struct.pack(">II", 0, n),
+                 b"".join(struct.pack(">I", len(s)) for s in samples))
+    sync = [i + 1 for i, k in enumerate(keys) if k]
+    stss = _full(b"stss", 0, 0, struct.pack(">I", len(sync)),
+                 b"".join(struct.pack(">I", i) for i in sync))
+    # stco patched once the moov size (hence mdat offset) is known.
+    stco_payload_off_placeholder = 0
+    stco = _full(b"stco", 0, 0,
+                 struct.pack(">II", 1, stco_payload_off_placeholder))
+
+    stbl = _box(b"stbl", stsd, stts, stsc, stsz, stss, stco)
+    vmhd = _full(b"vmhd", 0, 1, struct.pack(">4H", 0, 0, 0, 0))
+    dinf = _box(b"dinf", _full(b"dref", 0, 0, struct.pack(">I", 1),
+                               _full(b"url ", 0, 1)))
+    minf = _box(b"minf", vmhd, dinf, stbl)
+    mdhd = _full(b"mdhd", 0, 0, struct.pack(">IIIIHH", 0, 0, timescale,
+                                            duration, 0x55C4, 0))
+    hdlr = _full(b"hdlr", 0, 0, struct.pack(">I", 0), b"vide",
+                 b"\x00" * 12, b"VideoHandler\x00")
+    mdia = _box(b"mdia", mdhd, hdlr, minf)
+    tkhd = _full(b"tkhd", 0, 3, struct.pack(">IIIIII", 0, 0, 1, 0, duration,
+                                            0),
+                 struct.pack(">IIHHHH", 0, 0, 0, 0, 0, 0), _matrix(),
+                 struct.pack(">II", w << 16, h << 16))
+    trak = _box(b"trak", tkhd, mdia)
+    mvhd = _full(b"mvhd", 0, 0, struct.pack(">IIII", 0, 0, timescale,
+                                            duration),
+                 struct.pack(">IH", 0x00010000, 0x0100), b"\x00" * 10,
+                 _matrix(), b"\x00" * 24, struct.pack(">I", 2))
+    moov = _box(b"moov", mvhd, trak)
+
+    mdat_payload = b"".join(samples)
+    mdat = _box(b"mdat", mdat_payload)
+    # faststart layout: ftyp, moov, mdat — chunk data begins after the
+    # mdat header.
+    mdat_offset = len(ftyp) + len(moov) + 8
+    moov = moov.replace(
+        _full(b"stco", 0, 0, struct.pack(">II", 1, 0)),
+        _full(b"stco", 0, 0, struct.pack(">II", 1, mdat_offset)), 1)
+    return ftyp + moov + mdat
+
+
+def write_mp4(path, stream: bytes, meta: VideoMeta) -> int:
+    data = mux_mp4(stream, meta)
+    with open(path, "wb") as fp:
+        fp.write(data)
+    return len(data)
