@@ -2,21 +2,15 @@
 
 Tests run on CPU with 8 virtual devices so multi-chip sharding paths
 (`shard_map` over a Mesh) are exercised without TPU hardware — the
-JAX-native "fake cluster" (SURVEY.md §4).
-
-Note: this image boots an `axon` (tunneled TPU) PJRT plugin from
-sitecustomize which force-selects `jax_platforms=axon,cpu`; env vars alone
-cannot override that, so we update the jax config directly after import.
+JAX-native "fake cluster" (SURVEY.md §4). The bootstrap recipe lives in
+thinvids_tpu.core.devices (shared with the driver's dryrun entry point).
 """
 
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from thinvids_tpu.core.devices import force_cpu_devices  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu_devices(8)

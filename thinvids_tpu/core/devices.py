@@ -1,0 +1,43 @@
+"""Virtual CPU device-mesh bootstrap.
+
+This image's sitecustomize boots an `axon` (tunneled, single-chip TPU)
+PJRT plugin and force-selects `jax_platforms=axon,cpu`; env vars alone
+cannot override that, so multi-device paths (tests, the driver's
+`dryrun_multichip`) must update the jax config directly BEFORE the first
+backend initialization. One shared implementation so the recipe cannot
+drift between callers.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_cpu_devices(n: int) -> None:
+    """Arrange for jax to expose >= `n` virtual CPU devices.
+
+    Must run before any jax backend is initialized; raises RuntimeError
+    (instead of failing later with a misleading device-count error) when
+    backends already exist with fewer devices.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(rf"{_FLAG}=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = f"{flags} {_FLAG}={n}".strip()
+    elif int(m.group(1)) < n:
+        os.environ["XLA_FLAGS"] = flags.replace(m.group(0), f"{_FLAG}={n}")
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError as exc:  # backends already initialized
+        if len(jax.devices()) < n:
+            raise RuntimeError(
+                f"jax backends already initialized with "
+                f"{len(jax.devices())} device(s); force_cpu_devices({n}) "
+                f"must be called before the first jax backend use"
+            ) from exc
