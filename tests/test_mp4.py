@@ -91,3 +91,32 @@ class TestMux:
     def test_no_parameter_sets_raises(self):
         with pytest.raises(ValueError, match="SPS/PPS"):
             mux_mp4(b"\x00\x00\x01\x65\x88", VideoMeta(width=16, height=16))
+
+    def test_tkhd_spec_layout(self):
+        # ISO 14496-12 §8.3.2 version-0 tkhd is exactly 92 bytes; the
+        # matrix and width/height must land on spec offsets (positional
+        # parsers like the ffmpeg mov demuxer read them by offset).
+        w, h = 64, 48
+        meta = VideoMeta(width=w, height=h, fps_num=30, fps_den=1)
+        mp4 = mux_mp4(encode_gop(clip(w, h, 4), meta, qp=30), meta)
+        at = mp4.find(b"tkhd") - 4
+        size = struct.unpack(">I", mp4[at:at + 4])[0]
+        assert size == 92
+        box = mp4[at:at + size]
+        # matrix at offset 40 within the box body (8 header + 4 verflags
+        # + 20 ids/duration + 16 reserved/layer/volume)
+        matrix = struct.unpack(">9I", box[48:84])
+        assert matrix == (0x10000, 0, 0, 0, 0x10000, 0, 0, 0, 0x40000000)
+        tw, th = struct.unpack(">II", box[84:92])
+        assert (tw >> 16, th >> 16) == (w, h)
+
+    def test_mdat_over_limit_raises(self, monkeypatch):
+        # The 4 GiB 32-bit box-size ceiling must fail loudly, not emit a
+        # corrupt file; exercised by lowering the guard threshold.
+        import thinvids_tpu.io.mp4 as mp4mod
+
+        meta = VideoMeta(width=64, height=48, fps_num=30, fps_den=1)
+        stream = encode_gop(clip(64, 48, 4), meta, qp=30)
+        monkeypatch.setattr(mp4mod, "_MAX_MDAT", 50)
+        with pytest.raises(ValueError, match="32-bit"):
+            mp4mod.mux_mp4(stream, meta)

@@ -1,0 +1,188 @@
+"""Executor: turns a reserved Job into sharded encode waves + a muxed file.
+
+The data-plane half the coordinator was missing: the reference's worker
+task chain `transcode → split → encode×N → stitch`
+(/root/reference/worker/tasks.py:810-833, 1354, 1741) collapsed onto a
+device mesh — "split" is the GOP plan, "encode×N" is the shard_map wave
+fan-out, "stitch" is the ordered concat + MP4 mux. Progress, heartbeats
+and completion flow back through the coordinator's token-fenced
+callbacks; a stale token halts the run between waves (the reference's
+halt checks at every stage, worker/tasks.py:1611-1651).
+
+Wave-level fault handling replaces the reference's part-level retry
+(worker/tasks.py:1385-1464): a wave that raises is re-dispatched up to
+`part_failure_max_retries` times before the job fails with stage/host
+attribution.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+from ..core.status import Status
+from ..io.mp4 import mux_mp4
+from ..io.y4m import read_y4m
+from ..core.types import concat_segments
+from .coordinator import Coordinator
+from .jobs import Job
+
+
+class HaltedError(RuntimeError):
+    """Run token went stale mid-run (stop/restart/watchdog revocation)."""
+
+
+class LocalExecutor:
+    """Runs reserved jobs on the local process's device mesh.
+
+    Plugs into :class:`Coordinator` as its launcher: `launch()` spawns a
+    worker thread per job (pass ``sync=True`` for deterministic tests).
+    """
+
+    def __init__(self, coordinator: Coordinator, output_dir: str,
+                 mesh=None, host: str = "local", sync: bool = False,
+                 encoder_factory: Callable | None = None) -> None:
+        self.coordinator = coordinator
+        self.output_dir = output_dir
+        self.mesh = mesh
+        self.host = host
+        self.sync = sync
+        #: test seam: (meta, settings, mesh) -> GopShardEncoder-like
+        self._encoder_factory = encoder_factory or self._default_encoder
+        self._threads: list[threading.Thread] = []
+
+    # -- coordinator launcher interface --------------------------------
+
+    def launch(self, job: Job) -> None:
+        if self.sync:
+            self.run(job)
+            return
+        t = threading.Thread(target=self.run, args=(job,), daemon=True,
+                             name=f"tvt-exec-{job.id[:8]}")
+        self._threads.append(t)
+        t.start()
+
+    def join(self, timeout: float | None = None) -> None:
+        for t in self._threads:
+            t.join(timeout)
+
+    # -- pipeline ------------------------------------------------------
+
+    @staticmethod
+    def _default_encoder(meta, settings, mesh):
+        from ..parallel.dispatch import GopShardEncoder
+
+        return GopShardEncoder(
+            meta, qp=int(settings.qp), mesh=mesh,
+            gop_frames=int(settings.gop_frames),
+            max_segments=int(settings.max_segments))
+
+    def run(self, job: Job) -> None:
+        co = self.coordinator
+        token = job.run_token
+        stage = "probe"
+        try:
+            settings = co.job_settings(job)
+            co.heartbeat_job(job.id, token, stage, host=self.host)
+            meta, frames = read_y4m(job.input_path)
+            if not frames:
+                raise ValueError(f"no frames in {job.input_path}")
+            if not co.mark_running(job.id, token):
+                raise HaltedError("fenced before start")
+
+            stage = "segment"
+            enc = self._encoder_factory(meta, settings, self.mesh)
+            plan = enc.plan(len(frames))
+            co.update_progress(job.id, token, parts_total=plan.num_gops,
+                               segment_progress=100.0)
+            co.heartbeat_job(job.id, token,
+                             stage, host=self.host,
+                             note=f"{plan.num_gops} GOPs planned")
+
+            stage = "encode"
+            segments = self._encode_with_retry(job, token, enc, frames,
+                                               settings)
+
+            stage = "stitch"
+            co.heartbeat_job(job.id, token, stage, host=self.host)
+            stream = concat_segments(segments)
+            base = os.path.splitext(os.path.basename(job.input_path))[0]
+            out_path = os.path.join(self.output_dir, base + ".mp4")
+            os.makedirs(self.output_dir, exist_ok=True)
+            data = mux_mp4(stream, meta)
+            tmp = f"{out_path}.{job.id}.tmp"    # job-unique: no clobber
+                                                # across same-name jobs
+            with open(tmp, "wb") as fp:
+                fp.write(data)
+            os.replace(tmp, out_path)       # atomic commit (ref: tasks.py:769)
+            co.update_progress(job.id, token, combine_progress=100.0)
+            co.complete_job(job.id, token, out_path, len(data))
+        except HaltedError:
+            pass                            # fenced: a newer run owns the job
+        except Exception as exc:            # noqa: BLE001 - attribute & fail
+            co.fail_job(job.id, token, stage=stage, host=self.host,
+                        reason=f"{type(exc).__name__}: {exc}")
+
+    def _encode_with_retry(self, job: Job, token: str, enc, frames,
+                           settings) -> list:
+        """Depth-2 pipelined wave loop with per-wave retry + halt checks.
+
+        Staging stays lazy (stage_waves's bounded-HBM invariant): only the
+        <=2 in-flight waves keep their staged device arrays alive, and a
+        retried wave re-dispatches from its retained staged tuple.
+        """
+        co = self.coordinator
+        max_retries = int(settings.part_failure_max_retries)
+        total_gops = enc.plan(len(frames)).num_gops
+        staged_iter = enumerate(enc.stage_waves(frames))
+        segments: list = []
+        done = 0
+        pending: deque = deque()        # (idx, staged, handle)
+        attempts: dict[int, int] = {}
+
+        def halt_check() -> None:
+            if not co.token_is_current(job.id, token):
+                raise HaltedError("stale run token")
+
+        def dispatch_next() -> None:
+            try:
+                i, staged = next(staged_iter)
+            except StopIteration:
+                return
+            pending.append((i, staged, enc.dispatch_wave(staged)))
+
+        dispatch_next()
+        while pending:
+            halt_check()
+            if len(pending) < 2:
+                dispatch_next()         # overlap: depth-2 window, no more
+            i, staged, handle = pending.popleft()
+            try:
+                segs = enc.collect_wave(handle)
+            except HaltedError:
+                raise
+            except Exception as exc:    # noqa: BLE001 - wave retry budget
+                n = attempts.get(i, 0) + 1
+                attempts[i] = n
+                if n > max_retries:
+                    raise RuntimeError(
+                        f"wave {i} failed after {n - 1} retries: "
+                        f"{type(exc).__name__}: {exc}") from exc
+                co.activity.emit(
+                    "encode", f"wave {i} attempt {n} failed, retrying: "
+                    f"{exc}", job_id=job.id, host=self.host)
+                halt_check()
+                pending.appendleft((i, staged, enc.dispatch_wave(staged)))
+                continue
+            segments.extend(segs)
+            done += len(segs)
+            co.update_progress(
+                job.id, token, parts_done=done,
+                encode_progress=100.0 * done / max(1, total_gops))
+            co.heartbeat_job(job.id, token, "encode", host=self.host,
+                             note=f"{done}/{total_gops} GOPs")
+        segments.sort(key=lambda s: s.gop.index)
+        return segments

@@ -18,6 +18,10 @@ from ..core.types import VideoMeta
 _NAL_SPS, _NAL_PPS, _NAL_SEI, _NAL_AUD = 7, 8, 6, 9
 _NAL_IDR = 5
 
+# Largest mdat payload a 32-bit box size can carry (8 header bytes, and
+# the stco offsets must stay 32-bit too).
+_MAX_MDAT = 2**32 - 9
+
 
 def split_annexb(stream: bytes) -> list[bytes]:
     """Split an Annex-B byte stream into raw NAL units (no start codes)."""
@@ -135,8 +139,10 @@ def mux_mp4(stream: bytes, meta: VideoMeta) -> bytes:
     hdlr = _full(b"hdlr", 0, 0, struct.pack(">I", 0), b"vide",
                  b"\x00" * 12, b"VideoHandler\x00")
     mdia = _box(b"mdia", mdhd, hdlr, minf)
-    tkhd = _full(b"tkhd", 0, 3, struct.pack(">IIIIII", 0, 0, 1, 0, duration,
-                                            0),
+    # Spec layout (ISO 14496-12 §8.3.2, version 0; 92 bytes total):
+    # creation/modification/track_ID/reserved/duration, reserved[8],
+    # layer/alternate_group/volume/reserved, matrix, width/height.
+    tkhd = _full(b"tkhd", 0, 3, struct.pack(">IIIII", 0, 0, 1, 0, duration),
                  struct.pack(">IIHHHH", 0, 0, 0, 0, 0, 0), _matrix(),
                  struct.pack(">II", w << 16, h << 16))
     trak = _box(b"trak", tkhd, mdia)
@@ -146,8 +152,15 @@ def mux_mp4(stream: bytes, meta: VideoMeta) -> bytes:
                  _matrix(), b"\x00" * 24, struct.pack(">I", 2))
     moov = _box(b"moov", mvhd, trak)
 
-    mdat_payload = b"".join(samples)
-    mdat = _box(b"mdat", mdat_payload)
+    payload_bytes = sum(len(s) for s in samples)
+    if payload_bytes > _MAX_MDAT:
+        # All box sizes here are 32-bit; a largesize mdat would also need
+        # co64 chunk offsets. Fail loudly (and before allocating the full
+        # payload copy) rather than emit a broken file.
+        raise ValueError(
+            f"mdat payload {payload_bytes} bytes exceeds the 32-bit "
+            f"box-size limit (~4 GiB); split the clip into segments")
+    mdat = _box(b"mdat", b"".join(samples))
     # faststart layout: ftyp, moov, mdat — chunk data begins after the
     # mdat header.
     mdat_offset = len(ftyp) + len(moov) + 8

@@ -185,6 +185,7 @@ class GopShardEncoder:
         self.sps = SPS(width=meta.width, height=meta.height,
                        fps_num=meta.fps_num, fps_den=meta.fps_den)
         self.pps = PPS(init_qp=qp)
+        self._qp_arr = jnp.asarray(qp)      # hoisted: one upload per clip
 
     @property
     def num_devices(self) -> int:
@@ -233,6 +234,66 @@ class GopShardEncoder:
     def encode(self, frames: list[Frame]) -> list[EncodedSegment]:
         return self.encode_waves(self.stage_waves(frames))
 
+    def dispatch_wave(self, staged: tuple) -> tuple:
+        """Enqueue one staged wave's device compute (async); returns an
+        opaque pending handle for :meth:`collect_wave`."""
+        wave, ysd, usd, vsd = staged
+        qp = self._qp_arr
+        ph, pw = ysd.shape[2], ysd.shape[3]
+        mbh, mbw = ph // 16, pw // 16
+        wave_fn = _encode_wave_gop if self.inter else _encode_wave
+        out = wave_fn(ysd, usd, vsd, qp, mbw=mbw, mbh=mbh, mesh=self.mesh)
+        return (wave, ysd, usd, vsd, mbw, mbh, out)
+
+    def collect_wave(self, pending: tuple) -> list[EncodedSegment]:
+        """Fetch one dispatched wave's levels (sparse, with the dense
+        fallback) and entropy-pack its GOPs on host."""
+        wave, ysd, usd, vsd, mbw, mbh, out = pending
+        segments: list[EncodedSegment] = []
+        F = ysd.shape[1]
+        nmb = mbw * mbh
+        L = (nmb * _INTRA_MB + (F - 1) * nmb * _P_MB if self.inter
+             else nmb * _INTRA_MB)
+        nnz, n_esc, bitmap, vals, esc_pos, esc_val = jax.device_get(out)
+        sparse_ok = jaxcore.sparse_fits(nnz.max(), n_esc.max(), L)
+        if not sparse_ok:
+            dense_fn = (_encode_wave_gop_dense if self.inter
+                        else _encode_wave_dense)
+            flat = jax.device_get(dense_fn(
+                ysd, usd, vsd, jnp.asarray(self.qp), mbw=mbw, mbh=mbh,
+                mesh=self.mesh, dtype=jnp.int16))
+        for gi, gop in enumerate(wave):
+            if self.inter:
+                if sparse_ok:
+                    raw = jaxcore._sparse_unpack(
+                        int(nnz[gi]), int(n_esc[gi]), bitmap[gi],
+                        vals[gi], esc_pos[gi], esc_val[gi], L)
+                else:
+                    raw = flat[gi]
+                payload = self._pack_gop(gop, raw, F, mbw, mbh)
+            else:
+                payload = []
+                for fi in range(gop.num_frames):
+                    if sparse_ok:
+                        raw = jaxcore._sparse_unpack(
+                            int(nnz[gi, fi]), int(n_esc[gi, fi]),
+                            bitmap[gi, fi], vals[gi, fi],
+                            esc_pos[gi, fi], esc_val[gi, fi], L)
+                    else:
+                        raw = flat[gi, fi]
+                    levels = jaxcore._unpack_levels(raw, mbw, mbh)
+                    nal = pack_slice(
+                        levels, mbw, mbh, self.sps, self.pps,
+                        self.qp, idr=True,
+                        idr_pic_id=(gop.start_frame + fi) % 65536)
+                    if fi == 0:
+                        nal = self.sps.to_nal() + self.pps.to_nal() + nal
+                    payload.append(nal)
+            segments.append(EncodedSegment(
+                gop=gop, payload=b"".join(payload),
+                frame_sizes=tuple(len(p) for p in payload)))
+        return segments
+
     def encode_waves(self, waves) -> list[EncodedSegment]:
         """Dispatch staged waves: device compute → sparse fetch → host
         entropy pack, in wave order.
@@ -241,69 +302,21 @@ class GopShardEncoder:
         wave i's fetch, so its compute overlaps the fetch + pack without
         pinning the whole clip in device memory.
         """
-        qp = jnp.asarray(self.qp)
         segments: list[EncodedSegment] = []
         waves = iter(waves)
         pending: list[tuple] = []
 
         def dispatch_next():
             try:
-                wave, ysd, usd, vsd = next(waves)
+                staged = next(waves)
             except StopIteration:
                 return
-            ph, pw = ysd.shape[2], ysd.shape[3]
-            mbh, mbw = ph // 16, pw // 16
-            wave_fn = _encode_wave_gop if self.inter else _encode_wave
-            out = wave_fn(ysd, usd, vsd, qp, mbw=mbw, mbh=mbh,
-                          mesh=self.mesh)
-            pending.append((wave, ysd, usd, vsd, mbw, mbh, out))
+            pending.append(self.dispatch_wave(staged))
 
         dispatch_next()
         while pending:
             dispatch_next()                       # overlap: depth-2 window
-            wave, ysd, usd, vsd, mbw, mbh, out = pending.pop(0)
-            F = ysd.shape[1]
-            nmb = mbw * mbh
-            L = (nmb * _INTRA_MB + (F - 1) * nmb * _P_MB if self.inter
-                 else nmb * _INTRA_MB)
-            nnz, n_esc, bitmap, vals, esc_pos, esc_val = jax.device_get(out)
-            sparse_ok = jaxcore.sparse_fits(nnz.max(), n_esc.max(), L)
-            if not sparse_ok:
-                dense_fn = (_encode_wave_gop_dense if self.inter
-                            else _encode_wave_dense)
-                flat = jax.device_get(dense_fn(
-                    ysd, usd, vsd, qp, mbw=mbw, mbh=mbh,
-                    mesh=self.mesh, dtype=jnp.int16))
-            for gi, gop in enumerate(wave):
-                if self.inter:
-                    if sparse_ok:
-                        raw = jaxcore._sparse_unpack(
-                            int(nnz[gi]), int(n_esc[gi]), bitmap[gi],
-                            vals[gi], esc_pos[gi], esc_val[gi], L)
-                    else:
-                        raw = flat[gi]
-                    payload = self._pack_gop(gop, raw, F, mbw, mbh)
-                else:
-                    payload = []
-                    for fi in range(gop.num_frames):
-                        if sparse_ok:
-                            raw = jaxcore._sparse_unpack(
-                                int(nnz[gi, fi]), int(n_esc[gi, fi]),
-                                bitmap[gi, fi], vals[gi, fi],
-                                esc_pos[gi, fi], esc_val[gi, fi], L)
-                        else:
-                            raw = flat[gi, fi]
-                        levels = jaxcore._unpack_levels(raw, mbw, mbh)
-                        nal = pack_slice(
-                            levels, mbw, mbh, self.sps, self.pps,
-                            self.qp, idr=True,
-                            idr_pic_id=(gop.start_frame + fi) % 65536)
-                        if fi == 0:
-                            nal = self.sps.to_nal() + self.pps.to_nal() + nal
-                        payload.append(nal)
-                segments.append(EncodedSegment(
-                    gop=gop, payload=b"".join(payload),
-                    frame_sizes=tuple(len(p) for p in payload)))
+            segments.extend(self.collect_wave(pending.pop(0)))
         return segments
 
     def _pack_gop(self, gop: GopSpec, flat: np.ndarray, F: int, mbw: int,
