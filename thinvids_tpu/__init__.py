@@ -3,27 +3,26 @@
 A ground-up rebuild of the capabilities of AwsGeek/thinvids (a Redis/Huey/
 ffmpeg/VAAPI thin-client transcoding farm) designed TPU-first:
 
-- the encode path is JAX/Pallas kernels (integer transforms, quantization,
-  intra prediction, block motion estimation, deblocking) over HBM-resident
-  YUV planes instead of external ffmpeg+VAAPI processes;
-- segment/GOP parallelism uses ``jax.sharding.Mesh`` + ``shard_map`` with
-  ICI collectives for rate-control stats instead of Huey task dispatch to
+- the encode path is jitted JAX compute (integer transforms, quantization,
+  intra prediction, block motion estimation) over HBM-resident YUV planes
+  plus a native C++ CAVLC entropy packer, instead of external ffmpeg+VAAPI
+  processes;
+- segment/GOP parallelism uses ``jax.sharding.Mesh`` + ``shard_map``
+  (one closed GOP per device per wave) instead of Huey task dispatch to
   worker nodes;
 - the control plane (job store, scheduler, watchdog, heartbeats, activity
-  log) is an in-process coordinator with an HTTP API mirroring the
-  reference's Flask surface (reference: /root/reference/manager/app.py).
+  log, executor) is an in-process coordinator whose semantics port the
+  reference's manager (reference: /root/reference/manager/app.py).
 
-Layout (maps to SURVEY.md §7.1):
-    core/      video types, layered config, status/events, logging
-    codecs/    H.264 (and HEVC/AV1 scaffolding) kernels + entropy coding
-    pipeline/  jitted per-GOP encode functions + rate control
-    parallel/  segment planner, mesh helpers, shard_map dispatch
-    cluster/   coordinator, job store, scheduler, watchdog, agent
-    ingest/    watch-folder daemon, processed ledger, probing
-    io/        y4m / Annex-B / IVF / MP4 container IO
-    api/       HTTP API + dashboard UI
-    tools/     stamp seam verification, quality metrics, benchmarks
-    native/    C++ hot paths (entropy packing) loaded via ctypes
+Layout:
+    core/      video types, layered config, status/events, logging, devices
+    codecs/    H.264 intra+inter encode (JAX compute, bit-exact vs
+               libavcodec) + CAVLC entropy coding
+    parallel/  segment planner, mesh helpers, shard_map GOP dispatch
+    cluster/   coordinator, job store, admission policy, executor
+    io/        y4m reader, bit writer, MP4 muxer
+    tools/     libavcodec ctypes oracle (conformance decode)
+    native/    C++ hot paths (CAVLC entropy packing) loaded via ctypes
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
