@@ -2,20 +2,27 @@
 compensation, residual transform/quant, closed-loop reconstruction.
 
 Replaces the inter coding half of the reference's ffmpeg encode op point
-(/root/reference/worker/tasks.py:1558-1586). TPU-shaped design:
+(/root/reference/worker/tasks.py:1558-1586). TPU-shaped design — the
+governing constraint is that arbitrary per-MB gathers and tiny blocked
+layouts ((n, 16, 4, 4)) map terribly onto the VPU's (8, 128) registers,
+so every hot op works on whole (H, W) planes:
 
-- Motion estimation is FULL-SEARCH over a fixed ±SR integer-pel grid —
-  one whole-frame |cur - shifted_ref| + per-MB reduction per candidate,
-  iterated with `lax.map` (fixed trip count, static shapes; the classic
-  data-dependent diamond/TSS searches are the wrong shape for SPMD —
-  SURVEY.md §7.3 #2).
-- MVs only affect *bitstream* prediction (mvd), not compute, so every MB
-  of a P frame is encoded in parallel given the previous reconstruction;
-  frames chain through a `lax.scan` carry holding the recon planes.
-- Luma MC is integer-pel (a gather); chroma rides the same MV at 1/8-pel
-  resolution via the spec's bilinear formula (fracs ∈ {0, 4}).
-- Reconstruction clamps reference reads at the padded frame edge, which
-  is exactly the spec's unrestricted-MV edge padding.
+- Motion estimation + compensation are ONE fused candidate loop over
+  UNIFORM whole-frame shifts (`lax.dynamic_slice`, no gathers): each
+  candidate's shifted reference is SAD-reduced per MB and selected into
+  the prediction planes where it wins. Candidate centers come from a
+  quarter-resolution global-motion probe, the median of the previous
+  frame's vectors (the EPZS temporal predictor collapsed to its frame
+  mode), and zero — each refined over a small window. Per-MB deviation
+  beyond the windows is absorbed by residual coding; this trades a
+  little bitrate on chaotic motion for an order of magnitude in device
+  time vs per-MB search (the gather formulation measured ~93 ms/frame
+  at 1080p; this loop runs whole-frame slices at HBM bandwidth).
+- Residual DCT/quant/dequant/IDCT run in PLANE layout: 4x4 butterflies
+  as strided slices along H then W of the full frame — no (n, 16, 4, 4)
+  relayout in the hot loop, int16 storage.
+- Frames chain through a `lax.scan` carry holding the recon planes and
+  the previous MV field.
 
 The sequential P-slice entropy pack (skip runs, mvp/mvd, CBP) stays on
 host: codecs/h264/inter.py.
@@ -25,227 +32,357 @@ from __future__ import annotations
 
 import functools
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
 from .jaxcore import (
+    _MF,
     _QPC,
+    _V,
+    _ZZ,
     _ZSCAN,
-    _chroma_mb_batch,
-    _dequant,
-    _fwd4,
     _intra_core,
-    _inv4,
-    _quant,
     _varying_zero,
-    _zigzag,
 )
 
-SEARCH_RANGE = 16          # integer-pel, each direction
+SEARCH_RANGE = 16          # integer-pel, each direction (max |mv|)
 _MV_LAMBDA = 6             # SAD bias per |mv| unit — favors short vectors
+_WIN_RAD = 4               # refinement radius around each candidate center
+_ZERO_RAD = 1              # refinement radius around the zero vector
+_COARSE = 4                # global-motion probe downsample factor
 
 
-def _mb_blocks(x, n, b):
-    """(n, 16, 16) → (n, 16, 4, 4) in raster 4x4 order (for b=4)."""
-    return x.reshape(n, b, 4, b, 4).transpose(0, 1, 3, 2, 4).reshape(
-        n, b * b, 4, 4)
+# ---------------------------------------------------------------------------
+# plane-layout 4x4 transforms (bit-exact ports of jaxcore._fwd4/_inv4,
+# applied to whole (H, W) planes via length-4 strided butterflies)
+# ---------------------------------------------------------------------------
+
+def _fwd4_axis0(x):
+    """Forward core transform along H (rows of each 4x4 block)."""
+    H, W = x.shape
+    v = x.reshape(H // 4, 4, W)
+    a, b, c, d = v[:, 0], v[:, 1], v[:, 2], v[:, 3]
+    s0, s3 = a + d, a - d
+    s1, s2 = b + c, b - c
+    return jnp.stack(
+        [s0 + s1, 2 * s3 + s2, s0 - s1, s3 - 2 * s2], axis=1
+    ).reshape(H, W)
 
 
-def _mb_unblocks(x, n, b):
-    return x.reshape(n, b, b, 4, 4).transpose(0, 1, 3, 2, 4).reshape(
-        n, b * 4, b * 4)
+def _fwd4_axis1(x):
+    """Forward core transform along W (columns of each 4x4 block)."""
+    H, W = x.shape
+    v = x.reshape(H, W // 4, 4)
+    a, b, c, d = v[..., 0], v[..., 1], v[..., 2], v[..., 3]
+    s0, s3 = a + d, a - d
+    s1, s2 = b + c, b - c
+    return jnp.stack(
+        [s0 + s1, 2 * s3 + s2, s0 - s1, s3 - 2 * s2], axis=-1
+    ).reshape(H, W)
 
 
-def _motion_search(cur, ref_pad, mbw: int, mbh: int, sr: int):
-    """Dense full-search integer ME over the ±sr shift grid: one
-    whole-frame |cur - shifted_ref| + per-MB reduction per candidate,
-    iterated with `lax.map` (fixed trip count, static shapes — the
-    classic data-dependent diamond/TSS walks are the wrong shape for
-    SPMD, SURVEY.md §7.3 #2). Subsampled candidate grids are NOT used:
-    on grainy content only exact alignment scores low, so a stride-2 or
-    half-res pyramid stage misses the sharp minimum entirely (measured).
+def _fwd4_plane(x):
+    """W = CF @ x @ CF^T per 4x4 block, plane layout (H then W — same
+    order as jaxcore._fwd4's einsum)."""
+    return _fwd4_axis1(_fwd4_axis0(x))
 
-    cur: (H, W) int32; ref_pad: (H+2sr, W+2sr) int32 edge-padded.
-    Returns mv (mbh, mbw, 2) int32 as (dy, dx) in [-sr, sr].
+
+def _inv4_axis1(d):
+    H, W = d.shape
+    v = d.reshape(H, W // 4, 4)
+    d0, d1, d2, d3 = v[..., 0], v[..., 1], v[..., 2], v[..., 3]
+    e0, e1 = d0 + d2, d0 - d2
+    e2, e3 = (d1 >> 1) - d3, d1 + (d3 >> 1)
+    return jnp.stack([e0 + e3, e1 + e2, e1 - e2, e0 - e3],
+                     axis=-1).reshape(H, W)
+
+
+def _inv4_axis0(f):
+    H, W = f.shape
+    v = f.reshape(H // 4, 4, W)
+    g0, g1, g2, g3 = v[:, 0], v[:, 1], v[:, 2], v[:, 3]
+    h0, h1 = g0 + g2, g0 - g2
+    h2, h3 = (g1 >> 1) - g3, g1 + (g3 >> 1)
+    return jnp.stack([h0 + h3, h1 + h2, h1 - h2, h0 - h3],
+                     axis=1).reshape(H, W)
+
+
+def _inv4_plane(d):
+    """Inverse core transform, plane layout (W then H — exactly
+    jaxcore._inv4's stage order, which matters for the >>1 rounding)."""
+    return _inv4_axis0(_inv4_axis1(d))
+
+
+def _tile_plane(tbl, H, W):
+    """Tile a (4, 4) per-coefficient table over an (H, W) plane."""
+    return jnp.tile(tbl, (H // 4, W // 4))
+
+
+def _quant_plane(w, mf_plane, qp):
+    qbits = 15 + qp // 6
+    f = (1 << qbits) // 3
+    z = (jnp.abs(w) * mf_plane + f) >> qbits
+    return jnp.where(w < 0, -z, z)
+
+
+def _dequant_plane(z, v_plane, qp):
+    return (z * v_plane) << (qp // 6)
+
+
+# ---------------------------------------------------------------------------
+# fused motion search + compensation (uniform-shift candidate loop)
+# ---------------------------------------------------------------------------
+
+def _mb_sad(ad, mbw: int, mbh: int):
+    """(H, W) int16 abs-diff plane → per-MB int32 SAD (mbh, mbw).
+
+    Two-stage reduce: 16-wide row sums stay int16 (≤ 16*255 = 4080),
+    the 16-row combine promotes to int32."""
+    H = ad.shape[0]
+    s1 = ad.reshape(H, mbw, 16).sum(-1, dtype=jnp.int16)
+    return s1.reshape(mbh, 16, mbw).sum(1, dtype=jnp.int32)
+
+
+def _box_sum(x, s: int):
+    """(H, W) → (H/s, W/s) sums of s x s boxes (int16-safe for s=4:
+    16 * 255 = 4080)."""
+    H, W = x.shape
+    return x.reshape(H // s, s, W // s, s).sum((1, 3), dtype=jnp.int16)
+
+
+def _candidate_centers(cur16, ref16, pred_mv, sr: int):
+    """Three search centers: quarter-res global-motion probe, the
+    previous frame's median MV (a (2,) vector), zero. All clamped so
+    every window candidate stays inside ±(sr).
+
+    The probe compares BOX-SUM (antialiased) quarter-res planes, not
+    subsampled ones: on grainy content a stride-s subsample only scores
+    exact alignments, so a true global shift that is not a multiple of
+    `_COARSE` would see a flat SAD surface; box sums keep the minimum's
+    basin visible at ±1 box, and the full-res ±_WIN_RAD window around
+    the chosen center absorbs the ≤ _COARSE-1 px quantization."""
+    qs = _COARSE
+    cq = _box_sum(cur16, qs)
+    rq = _box_sum(ref16, qs)
+    qsr = sr // qs
+    rq_pad = jnp.pad(rq, qsr, mode="edge")
+    qh, qw = cq.shape
+
+    def body(i, carry):
+        bc, bi = carry
+        dy, dx = i // (2 * qsr + 1), i % (2 * qsr + 1)
+        win = jax.lax.dynamic_slice(rq_pad, (dy, dx), (qh, qw))
+        cost = jnp.abs(cq - win).astype(jnp.int32).sum()
+        take = cost < bc
+        return jnp.where(take, cost, bc), jnp.where(take, i, bi)
+
+    big = jnp.int32(2**30) + _varying_zero(cur16)
+    _, bi = jax.lax.fori_loop(0, (2 * qsr + 1) ** 2, body,
+                              (big, _varying_zero(cur16)))
+    coarse = jnp.stack([bi // (2 * qsr + 1) - qsr,
+                        bi % (2 * qsr + 1) - qsr]) * qs
+
+    lim = sr - _WIN_RAD
+    return (jnp.clip(coarse, -lim, lim), jnp.clip(pred_mv, -lim, lim))
+
+
+def _search_mc(cy16, ry16, ru16, rv16, pred_mv, *, mbw: int, mbh: int,
+               sr: int):
+    """Fused ME+MC: evaluate uniform shift candidates (centers ± window,
+    zero ± 1), keeping per-MB the best (cost, mv) AND the corresponding
+    prediction planes — luma integer-pel, chroma 1/8-pel bilinear per
+    §8.4.2.2.2 (fracs ∈ {0, 4}), all via whole-plane dynamic slices.
+
+    cy16: (H, W) int16 current luma; r*16: int16 recon planes of the
+    reference frame. Returns (mv (mbh, mbw, 2) int32, pred_y, pred_u,
+    pred_v int16 planes).
     """
-    H, W = cur.shape
-    S = 2 * sr + 1
-
-    def cost_for(shift):
-        dy = shift // S
-        dx = shift % S
-        win = jax.lax.dynamic_slice(ref_pad, (dy, dx), (H, W))
-        ad = jnp.abs(cur - win)
-        sad = ad.reshape(mbh, 16, mbw, 16).sum(axis=(1, 3))
-        mv_cost = _MV_LAMBDA * (jnp.abs(dy - sr) + jnp.abs(dx - sr))
-        return sad + mv_cost
-
-    costs = jax.lax.map(cost_for, jnp.arange(S * S), batch_size=S)
-    best = jnp.argmin(costs, axis=0).astype(jnp.int32)   # (mbh, mbw)
-    return jnp.stack([best // S - sr, best % S - sr], axis=-1)
-
-
-_REFINE = 2                # refinement radius around each MV predictor
-
-
-def _motion_search_pred(cur, ref_pad, pred_mv, mbw: int, mbh: int, sr: int):
-    """Predictor-guided ME (the EPZS idea, SPMD-shaped): evaluate the
-    temporal predictor (this MB's vector in the previous frame) and the
-    zero vector, each refined over a ±_REFINE window — ~40x less work
-    than the dense grid. Falls back gracefully: the zero candidate plus
-    refinement bounds the damage when motion changes abruptly, and the
-    first P frame of a GOP uses the dense search (no predictor yet).
-
-    All candidates are static-shape gathers; per-MB best by unrolled
-    min-tree. Returns mv (mbh, mbw, 2) int32 in [-sr, sr].
-    """
-    r = _REFINE
-    cur_mb = cur.reshape(mbh, 16, mbw, 16).transpose(0, 2, 1, 3)
-    idx = jnp.arange(16 + 2 * r)
-    my = jnp.arange(mbh)
-    mx = jnp.arange(mbw)
-
-    best_cost = None
-    best_mv = None
-    for cand in (jnp.clip(pred_mv, -(sr - r), sr - r),
-                 jnp.zeros_like(pred_mv)):
-        rows = (my[:, None] * 16 + sr - r)[:, :, None, None] \
-            + cand[..., 0][..., None, None] + idx[None, None, :, None]
-        cols = (mx[None, :] * 16 + sr - r)[:, :, None, None] \
-            + cand[..., 1][..., None, None] + idx[None, None, None, :]
-        window = ref_pad[rows, cols]             # (mbh, mbw, 16+2r, 16+2r)
-        for dy in range(2 * r + 1):
-            for dx in range(2 * r + 1):
-                w = window[:, :, dy:dy + 16, dx:dx + 16]
-                sad = jnp.abs(cur_mb - w).sum(axis=(2, 3))
-                off = jnp.stack([
-                    jnp.broadcast_to(jnp.int32(dy - r), sad.shape),
-                    jnp.broadcast_to(jnp.int32(dx - r), sad.shape)],
-                    axis=-1)
-                total = cand + off
-                cost = sad + _MV_LAMBDA * jnp.abs(total).sum(-1)
-                if best_cost is None:
-                    best_cost, best_mv = cost, total
-                else:
-                    take = cost < best_cost
-                    best_cost = jnp.where(take, cost, best_cost)
-                    best_mv = jnp.where(take[..., None], total, best_mv)
-    return best_mv
-
-
-def _mc_luma(ref_pad, mv, mbw: int, mbh: int, sr: int):
-    """Integer-pel luma MC: (mbh*mbw, 16, 16) predicted blocks."""
-    r = jnp.arange(16)
-    my = jnp.arange(mbh)
-    mx = jnp.arange(mbw)
-    rows = (my[:, None] * 16 + sr)[:, :, None, None] \
-        + mv[..., 0][..., None, None] + r[None, None, :, None]
-    cols = (mx[None, :] * 16 + sr)[:, :, None, None] \
-        + mv[..., 1][..., None, None] + r[None, None, None, :]
-    pred = ref_pad[rows, cols]                       # (mbh, mbw, 16, 16)
-    return pred.reshape(mbh * mbw, 16, 16)
-
-
-def _mc_chroma(ref_pad, mv, mbw: int, mbh: int, sr: int):
-    """Chroma MC at 1/8-pel: bilinear per §8.4.2.2.2, fracs ∈ {0,4}.
-
-    ref_pad: (H/2 + 2*(sr//2+1), W/2 + ...) edge-padded chroma plane with
-    pad `cpad = sr // 2 + 1` (integer part of the largest chroma MV plus
-    one for the +1 bilinear tap).
-    """
+    H, W = cy16.shape
     cpad = sr // 2 + 1
-    ci = mv >> 1                                     # integer chroma offset
-    frac = (mv & 1) * 4                              # 0 or 4 (x8 units)
-    r = jnp.arange(8)
-    my = jnp.arange(mbh)
-    mx = jnp.arange(mbw)
-    rows = (my[:, None] * 8 + cpad)[:, :, None, None] \
-        + ci[..., 0][..., None, None] + r[None, None, :, None]
-    cols = (mx[None, :] * 8 + cpad)[:, :, None, None] \
-        + ci[..., 1][..., None, None] + r[None, None, None, :]
-    a = ref_pad[rows, cols]
-    b = ref_pad[rows, cols + 1]
-    c = ref_pad[rows + 1, cols]
-    d = ref_pad[rows + 1, cols + 1]
-    xf = frac[..., 1][..., None, None]
-    yf = frac[..., 0][..., None, None]
-    pred = ((8 - xf) * (8 - yf) * a + xf * (8 - yf) * b
-            + (8 - xf) * yf * c + xf * yf * d + 32) >> 6
-    return pred.reshape(mbh * mbw, 8, 8)
+    ref_y = jnp.pad(ry16, sr, mode="edge")
+    ref_u = jnp.pad(ru16, cpad, mode="edge")
+    ref_v = jnp.pad(rv16, cpad, mode="edge")
+
+    centers = _candidate_centers(cy16, ry16, pred_mv, sr)
+    # Candidate list: two windows of ±_WIN_RAD around the centers plus a
+    # ±_ZERO_RAD window around zero (skip-friendliness).
+    wr, zr = _WIN_RAD, _ZERO_RAD
+    win = 2 * wr + 1
+    zwin = 2 * zr + 1
+    offs = []
+    for cidx in range(len(centers)):
+        for i in range(win * win):
+            offs.append((cidx, i // win - wr, i % win - wr))
+    for i in range(zwin * zwin):
+        offs.append((-1, i // zwin - zr, i % zwin - zr))
+    n_cand = len(offs)
+    cand_center = jnp.asarray([o[0] for o in offs], jnp.int32)
+    cand_off = jnp.asarray([[o[1], o[2]] for o in offs], jnp.int32)
+    centers_arr = jnp.stack(list(centers) + [jnp.zeros(2, jnp.int32)])
+
+    zero = _varying_zero(cy16)
+
+    def body(i, carry):
+        bc, bmy, bmx, py, pu, pv = carry
+        c = centers_arr[cand_center[i]]
+        dy = c[0] + cand_off[i, 0]
+        dx = c[1] + cand_off[i, 1]
+        win_y = jax.lax.dynamic_slice(ref_y, (dy + sr, dx + sr), (H, W))
+        sad = _mb_sad(jnp.abs(cy16 - win_y), mbw, mbh)
+        cost = sad + _MV_LAMBDA * (jnp.abs(dy) + jnp.abs(dx))
+        take = cost < bc                                  # (mbh, mbw)
+
+        # chroma prediction for this shift (1/8-pel bilinear, frac 0|4)
+        ciy, cix = dy >> 1, dx >> 1
+        yf, xf = (dy & 1) * 4, (dx & 1) * 4
+
+        def bilerp(ref):
+            a = jax.lax.dynamic_slice(ref, (ciy + cpad, cix + cpad),
+                                      (H // 2, W // 2))
+            b = jax.lax.dynamic_slice(ref, (ciy + cpad, cix + cpad + 1),
+                                      (H // 2, W // 2))
+            cc = jax.lax.dynamic_slice(ref, (ciy + cpad + 1, cix + cpad),
+                                       (H // 2, W // 2))
+            d = jax.lax.dynamic_slice(ref, (ciy + cpad + 1, cix + cpad + 1),
+                                      (H // 2, W // 2))
+            return (((8 - xf) * (8 - yf) * a + xf * (8 - yf) * b
+                     + (8 - xf) * yf * cc + xf * yf * d + 32) >> 6
+                    ).astype(jnp.int16)
+
+        win_u = bilerp(ref_u)
+        win_v = bilerp(ref_v)
+
+        take_y = jnp.broadcast_to(take[:, None, :, None],
+                                  (mbh, 16, mbw, 16)).reshape(H, W)
+        take_c = jnp.broadcast_to(take[:, None, :, None],
+                                  (mbh, 8, mbw, 8)).reshape(H // 2, W // 2)
+        return (jnp.where(take, cost, bc),
+                jnp.where(take, dy, bmy).astype(jnp.int32),
+                jnp.where(take, dx, bmx).astype(jnp.int32),
+                jnp.where(take_y, win_y, py),
+                jnp.where(take_c, win_u, pu),
+                jnp.where(take_c, win_v, pv))
+
+    bc = jnp.full((mbh, mbw), 2**30, jnp.int32) + zero
+    bmy = jnp.zeros((mbh, mbw), jnp.int32) + zero
+    bmx = jnp.zeros((mbh, mbw), jnp.int32) + zero
+    py = jnp.zeros((H, W), jnp.int16) + zero.astype(jnp.int16)
+    pu = jnp.zeros((H // 2, W // 2), jnp.int16) + zero.astype(jnp.int16)
+    pv = jnp.zeros((H // 2, W // 2), jnp.int16) + zero.astype(jnp.int16)
+    bc, bmy, bmx, py, pu, pv = jax.lax.fori_loop(
+        0, n_cand, body, (bc, bmy, bmx, py, pu, pv))
+    mv = jnp.stack([bmy, bmx], axis=-1)
+    return mv, py, pu, pv
 
 
-def _luma_inter_mb_batch(src, pred, qp):
-    """Inter luma residual: 16 standalone 4x4 transforms (no DC split).
+# ---------------------------------------------------------------------------
+# P-frame residual coding in plane layout
+# ---------------------------------------------------------------------------
 
-    src/pred: (n, 16, 16) int32 → (levels (n, 16, 16) z-scan blocks of
-    16 zig-zag coeffs, recon (n, 16, 16)).
+def _dc_mask(H, W):
+    m = np.ones((4, 4), np.int16)
+    m[0, 0] = 0
+    return jnp.asarray(np.tile(m, (H // 4, W // 4)))
+
+
+def _luma_plane_to_blocks(z, mbw: int, mbh: int):
+    """(H, W) coeff plane → (nmb, 16, 16) z-scan blocks of zigzag
+    coeffs (the packer's layout)."""
+    x = z.reshape(mbh, 4, 4, mbw, 4, 4).transpose(0, 3, 1, 4, 2, 5)
+    x = x.reshape(mbh * mbw, 16, 16)
+    return x[:, _ZSCAN][..., _ZZ]
+
+
+def _chroma_plane_to_blocks(z, mbw: int, mbh: int):
+    """(H/2, W/2) coeff plane → (nmb, 4, 16) raster blocks of zigzag
+    coeffs."""
+    x = z.reshape(mbh, 2, 4, mbw, 2, 4).transpose(0, 3, 1, 4, 2, 5)
+    x = x.reshape(mbh * mbw, 4, 16)
+    return x[..., _ZZ]
+
+
+def _encode_p_plane(cy, cu, cv, ry, ru, rv, pred_mv, qp, qpc, *, mbw: int,
+                    mbh: int, sr: int = SEARCH_RANGE):
+    """One P frame given previous recon planes (int16). Returns blocked
+    level arrays (the host packer's layout) + new recon planes (int16).
     """
-    n = src.shape[0]
-    resid = src - pred
-    blocks = _mb_blocks(resid, n, 4)                 # raster 4x4 order
-    w = _fwd4(blocks)
-    z = _quant(w, qp, skip_dc=False)
-    levels = _zigzag(z)[:, _ZSCAN]                   # (n, 16, 16) z-scan
-    d = _dequant(z, qp)
-    r = (_inv4(d) + 32) >> 6
-    rec = jnp.clip(_mb_unblocks(r, n, 4) + pred, 0, 255)
-    return levels, rec
-
-
-def _pad_ref(plane, pad):
-    return jnp.pad(plane, pad, mode="edge")
-
-
-def _encode_p_core(cy, cu, cv, ry, ru, rv, qp, qpc, pred_mv=None,
-                   use_pred=None, *, mbw: int, mbh: int,
-                   sr: int = SEARCH_RANGE):
-    """One P frame given previous recon (ry, ru, rv). All MBs parallel.
-
-    `pred_mv`/`use_pred`: optional temporal MV predictor field — when
-    `use_pred` is true the cheap predictor-guided search runs instead of
-    the dense grid (the GOP scan passes the previous frame's vectors).
-
-    Returns (mv (nmb,2), luma_levels (nmb,16,16), chroma_dc (nmb,2,4),
-    chroma_ac (nmb,2,4,15), recon_y, recon_u, recon_v, mv_grid).
-    """
+    H, W = cy.shape
     n = mbw * mbh
-    cy = cy.astype(jnp.int32)
-    cu = cu.astype(jnp.int32)
-    cv = cv.astype(jnp.int32)
+    cy16 = cy.astype(jnp.int16)
+    cu16 = cu.astype(jnp.int16)
+    cv16 = cv.astype(jnp.int16)
 
-    ref_y = _pad_ref(ry, sr)
-    if pred_mv is None:
-        mv = _motion_search(cy, ref_y, mbw, mbh, sr)     # (mbh, mbw, 2)
-    else:
-        mv = jax.lax.cond(
-            use_pred,
-            lambda: _motion_search_pred(cy, ref_y, pred_mv, mbw, mbh, sr),
-            lambda: _motion_search(cy, ref_y, mbw, mbh, sr))
+    mv, pred_y, pred_u, pred_v = _search_mc(
+        cy16, ry, ru, rv, pred_mv, mbw=mbw, mbh=mbh, sr=sr)
 
-    pred_y = _mc_luma(ref_y, mv, mbw, mbh, sr)
-    cpad = sr // 2 + 1
-    pred_u = _mc_chroma(_pad_ref(ru, cpad), mv, mbw, mbh, sr)
-    pred_v = _mc_chroma(_pad_ref(rv, cpad), mv, mbw, mbh, sr)
+    qp32 = qp.astype(jnp.int32)
+    mf_y = _tile_plane(_MF[qp32 % 6], H, W)
+    v_y = _tile_plane(_V[qp32 % 6], H, W)
+    mf_c = _tile_plane(_MF[qpc % 6], H // 2, W // 2)
+    v_c = _tile_plane(_V[qpc % 6], H // 2, W // 2)
 
-    src_y = cy.reshape(mbh, 16, mbw, 16).transpose(0, 2, 1, 3).reshape(
-        n, 16, 16)
-    src_u = cu.reshape(mbh, 8, mbw, 8).transpose(0, 2, 1, 3).reshape(n, 8, 8)
-    src_v = cv.reshape(mbh, 8, mbw, 8).transpose(0, 2, 1, 3).reshape(n, 8, 8)
+    # --- luma: 16 standalone 4x4 transforms per MB (no DC split) ---
+    resid = (cy16 - pred_y).astype(jnp.int32)
+    w = _fwd4_plane(resid)
+    z = _quant_plane(w, mf_y, qp32)
+    d = _dequant_plane(z, v_y, qp32)
+    recon_y = jnp.clip((_inv4_plane(d) + 32 >> 6) + pred_y, 0, 255
+                       ).astype(jnp.int16)
+    luma_levels = _luma_plane_to_blocks(z.astype(jnp.int16), mbw, mbh
+                                        ).astype(jnp.int32)
 
-    luma_levels, yrec = _luma_inter_mb_batch(src_y, pred_y, qp)
-    udc, uac, urec = _chroma_mb_batch(src_u, pred_u, qpc)
-    vdc, vac, vrec = _chroma_mb_batch(src_v, pred_v, qpc)
-    chroma_dc = jnp.stack([udc, vdc], axis=1)
-    chroma_ac = jnp.stack([uac, vac], axis=1)
+    # --- chroma: AC plane + 2x2 hadamard DC per MB ---
+    def chroma(cplane16, pred, mf_c, v_c):
+        h, wd_ = cplane16.shape
+        resid = (cplane16 - pred).astype(jnp.int32)
+        wch = _fwd4_plane(resid)
+        dc = wch[::4, ::4]                               # (2*mbh, 2*mbw)
+        g = dc.reshape(mbh, 2, mbw, 2)
+        a, b = g[:, 0, :, 0], g[:, 0, :, 1]
+        c, dd = g[:, 1, :, 0], g[:, 1, :, 1]
+        wd2 = jnp.stack([a + b + c + dd, a - b + c - dd,
+                         a + b - c - dd, a - b - c + dd], axis=-1)
+        # chroma DC quant (jaxcore._chroma_dc_quant, plane-free)
+        qbits = 15 + qpc // 6
+        f = (1 << qbits) // 3
+        mf00 = _MF[qpc % 6, 0, 0]
+        zdc = (jnp.abs(wd2) * mf00 + 2 * f) >> (qbits + 1)
+        zdc = jnp.where(wd2 < 0, -zdc, zdc)              # (mbh, mbw, 4)
+        # AC quant with DC positions zeroed
+        zac = _quant_plane(wch, mf_c, qpc) * _dc_mask(h, wd_)
+        # recon: dequant AC, reinsert dequantized DC, inverse
+        dac = _dequant_plane(zac, v_c, qpc)
+        z00, z01 = zdc[..., 0], zdc[..., 1]
+        z10, z11 = zdc[..., 2], zdc[..., 3]
+        f00 = z00 + z01 + z10 + z11
+        f01 = z00 - z01 + z10 - z11
+        f10 = z00 + z01 - z10 - z11
+        f11 = z00 - z01 - z10 + z11
+        ls = _V[qpc % 6, 0, 0] * 16
+        fdc = jnp.stack([jnp.stack([f00, f01], -1),
+                         jnp.stack([f10, f11], -1)], -2)  # (mbh,mbw,2,2)
+        dcr = ((fdc * ls) << (qpc // 6)) >> 5
+        dcr_grid = dcr.transpose(0, 2, 1, 3).reshape(2 * mbh, 2 * mbw)
+        dfull = dac.reshape(h // 4, 4, wd_ // 4, 4)
+        dfull = dfull.at[:, 0, :, 0].set(dcr_grid)
+        dfull = dfull.reshape(h, wd_)
+        rec = jnp.clip((_inv4_plane(dfull) + 32 >> 6) + pred, 0, 255
+                       ).astype(jnp.int16)
+        ac = _chroma_plane_to_blocks(zac.astype(jnp.int16), mbw, mbh
+                                     )[..., 1:].astype(jnp.int32)
+        dc_lev = zdc.reshape(n, 4)
+        return dc_lev, ac, rec
 
-    recon_y = yrec.reshape(mbh, mbw, 16, 16).transpose(0, 2, 1, 3).reshape(
-        16 * mbh, 16 * mbw)
-    recon_u = urec.reshape(mbh, mbw, 8, 8).transpose(0, 2, 1, 3).reshape(
-        8 * mbh, 8 * mbw)
-    recon_v = vrec.reshape(mbh, mbw, 8, 8).transpose(0, 2, 1, 3).reshape(
-        8 * mbh, 8 * mbw)
+    udc, uac, recon_u = chroma(cu16, pred_u, mf_c, v_c)
+    vdc, vac, recon_v = chroma(cv16, pred_v, mf_c, v_c)
+    chroma_dc = jnp.stack([udc, vdc], axis=1)            # (n, 2, 4)
+    chroma_ac = jnp.stack([uac, vac], axis=1)            # (n, 2, 4, 15)
+
+    med_mv = jnp.median(mv.reshape(-1, 2), axis=0).astype(jnp.int32)
     return (mv.reshape(n, 2), luma_levels, chroma_dc, chroma_ac,
-            recon_y, recon_u, recon_v, mv)
+            recon_y, recon_u, recon_v, med_mv)
 
 
 @functools.partial(jax.jit, static_argnames=("mbw", "mbh", "emit_recon"))
@@ -262,31 +399,32 @@ def encode_gop_jit(ys, us, vs, qp, *, mbw: int, mbh: int,
     qpc = _QPC[jnp.clip(qp, 0, 51)]
     (il_dc, il_ac, ic_dc, ic_ac, ry, ru, rv) = _intra_core(
         ys[0], us[0], vs[0], qp, mbw=mbw, mbh=mbh)
+    ry = ry.astype(jnp.int16)
+    ru = ru.astype(jnp.int16)
+    rv = rv.astype(jnp.int16)
 
     def p_step(carry, xs):
-        ry, ru, rv, prev_mv, has_pred = carry
+        ry, ru, rv, pred_mv = carry
         cy, cu, cv = xs
-        (mv, l16, cdc, cac, ry2, ru2, rv2, mv_grid) = _encode_p_core(
-            cy, cu, cv, ry, ru, rv, qp, qpc, prev_mv, has_pred,
-            mbw=mbw, mbh=mbh)
+        (mv, l16, cdc, cac, ry2, ru2, rv2, med_mv) = _encode_p_plane(
+            cy, cu, cv, ry, ru, rv, pred_mv, qp, qpc, mbw=mbw, mbh=mbh)
         outs = (mv, l16, cdc, cac)
         if emit_recon:
             outs = outs + (ry2, ru2, rv2)
-        return (ry2, ru2, rv2, mv_grid, jnp.bool_(True) | has_pred), outs
+        return (ry2, ru2, rv2, med_mv), outs
 
     # Inits derived from data (not constants) so the scan carries keep
     # the mesh-varying axes under shard_map — see jaxcore._varying_zero.
     zero = _varying_zero(ry)
-    zero_mv = jnp.zeros((mbh, mbw, 2), jnp.int32) + zero
+    zero_mv = jnp.zeros(2, jnp.int32) + zero
     _, pouts = jax.lax.scan(
-        p_step, (ry, ru, rv, zero_mv, zero.astype(jnp.bool_)),
-        (ys[1:], us[1:], vs[1:]))
+        p_step, (ry, ru, rv, zero_mv), (ys[1:], us[1:], vs[1:]))
     intra = (il_dc, il_ac, ic_dc, ic_ac)
     if emit_recon:
         mv, l16, cdc, cac, pry, pru, prv = pouts
-        recon_y = jnp.concatenate([ry[None], pry])
-        recon_u = jnp.concatenate([ru[None], pru])
-        recon_v = jnp.concatenate([rv[None], prv])
+        recon_y = jnp.concatenate([ry[None], pry]).astype(jnp.int32)
+        recon_u = jnp.concatenate([ru[None], pru]).astype(jnp.int32)
+        recon_v = jnp.concatenate([rv[None], prv]).astype(jnp.int32)
         return intra, (mv, l16, cdc, cac), (recon_y, recon_u, recon_v)
     mv, l16, cdc, cac = pouts
     return intra, (mv, l16, cdc, cac)
