@@ -1,17 +1,25 @@
-"""Benchmark: 1080p H.264 intra encode throughput on the current device.
+"""Benchmark: H.264 GOP (IDR + P) encode throughput on the current device.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": fps, "unit": "fps", "vs_baseline": x}
+  {"metric": ..., "value": fps, "unit": "fps", "vs_baseline": x, ...}
 
-`vs_baseline` is relative to real-time 30 fps — the reference's operating
-point is real-time-ish per-node hardware encode at 1080p
-(/root/reference/worker/tasks.py:1558-1586); the reference itself
-publishes no numbers (BASELINE.md), so 30 fps (1x real time) is the
-denominator.
+`value` is end-to-end 1080p fps through the production path: GOP-batched
+wave dispatch over the mesh (thinvids_tpu/parallel/dispatch.py) + async
+sparse level fetch + pooled host entropy pack (C++ CAVLC) + ordered
+concat. `vs_baseline` is relative to real-time 30 fps — the reference's
+per-node hardware encode operating point at 1080p
+(/root/reference/worker/tasks.py:1558-1586); the reference publishes no
+numbers (BASELINE.md), so 30 fps (1x real time) is the denominator.
 
-The measured path is the production default: jitted JAX compute on the
-accelerator (thinvids_tpu/codecs/h264/jaxcore.py) + native C++ CAVLC
-entropy pack on host. Compile time is excluded (one warmup iteration).
+Extra keys: `device_gop_fps` times the SAME GOP program device-side only
+(comparable to `value`, unlike the old intra-only figure), `fps_2160p`
+is the 4K end-to-end line (BASELINE config 3's resolution).
+
+Source frames are pre-staged in HBM before the timed region (the design
+invariant: kernels run over HBM-resident YUV planes; ingest/upload is a
+separate, overlappable pipeline stage).
+
+Compile time is excluded (one warmup wave per resolution).
 """
 
 from __future__ import annotations
@@ -51,69 +59,70 @@ def make_frames(n: int, w: int, h: int, seed: int = 0, pan: int = 3):
     return frames
 
 
-def main() -> None:
+def _run_pipeline(w: int, h: int, nframes: int, qp: int, gop_frames: int):
+    """(e2e fps, device-only fps, total bytes) for one resolution."""
     import jax
 
-    from thinvids_tpu.core.types import VideoMeta
-    from thinvids_tpu.codecs.h264.encoder import H264Encoder
+    from thinvids_tpu.core.types import VideoMeta, concat_segments
+    from thinvids_tpu.parallel.dispatch import GopShardEncoder
 
-    w, h, qp, nframes = 1920, 1080, 27, 24
-    platform = jax.devices()[0].platform
     frames = make_frames(nframes, w, h)
     meta = VideoMeta(width=w, height=h, fps_num=30, fps_den=1,
                      num_frames=nframes)
-    enc = H264Encoder(meta, qp=qp, use_jax=True)
+    enc = GopShardEncoder(meta, qp=qp, gop_frames=gop_frames)
+    _, waves = enc.prepare_waves(frames)
+    jax.block_until_ready([wv[1:] for wv in waves])   # force HBM staging
 
-    # Warmup: trigger jit compile + native packer build (excluded).
-    enc.encode_frame(frames[0], idr_pic_id=0)
+    # Warmup: compile EVERY distinct wave shape (the tail wave is
+    # usually smaller than the full ones) + build the native packer.
+    distinct = {}
+    for wv in waves:
+        distinct.setdefault(wv[1].shape, wv)
+    concat_segments(enc.encode_waves(list(distinct.values())))
 
-    # Device-only compute timing (jitted intra path, block_until_ready).
-    from thinvids_tpu.codecs.h264 import jaxcore
-    import jax.numpy as jnp
-
-    padded = [f.padded(16) for f in frames]
-    ph, pw = padded[0].y.shape
-    mbh, mbw = ph // 16, pw // 16
-    dev_frames = [(jnp.asarray(f.y), jnp.asarray(f.u), jnp.asarray(f.v))
-                  for f in padded]
-    qp_arr = jnp.asarray(qp, jnp.int32)
-    jaxcore._encode_intra(*dev_frames[0], qp_arr, mbw=mbw, mbh=mbh)  # warm
+    # Device-only: dispatch every wave, then a value barrier — fetch the
+    # last wave's (tiny) block-count array. A plain block_until_ready is
+    # unreliable over tunneled devices, and compiling a fresh reduction
+    # here would land compile time inside the timed region; an existing
+    # output fetch does neither. Device execution is in-order, so the
+    # last wave's completion implies all prior waves'.
     t0 = time.perf_counter()
-    for y, u, v in dev_frames:
-        out = jaxcore._encode_intra(y, u, v, qp_arr, mbw=mbw, mbh=mbh)
-    jax.block_until_ready(out)
-    t_device = time.perf_counter() - t0
+    outs = [enc.dispatch_wave(wv)[-1] for wv in waves]
+    _ = jax.device_get(outs[-1][1])
+    t_dev = time.perf_counter() - t0
 
-    # End-to-end production path: GOP-batched wave dispatch over the mesh
-    # + sparse level fetch + host entropy pack + ordered concat. Source
-    # frames are pre-staged in HBM (the design invariant: kernels run
-    # over HBM-resident YUV planes; ingest/upload is a separate,
-    # overlappable pipeline stage).
-    from thinvids_tpu.core.types import concat_segments
-    from thinvids_tpu.parallel.dispatch import GopShardEncoder
-
-    gop_frames = 8
-    enc_sharded = GopShardEncoder(meta, qp=qp, gop_frames=gop_frames)
-    _, waves = enc_sharded.prepare_waves(frames)
-    jax.block_until_ready([w[1:] for w in waves])   # force HBM staging
-    concat_segments(enc_sharded.encode_waves(waves))   # warm compile
+    # End-to-end production path.
     t0 = time.perf_counter()
-    stream = concat_segments(enc_sharded.encode_waves(waves))
+    stream = concat_segments(enc.encode_waves(waves))
     t_e2e = time.perf_counter() - t0
-    total_bytes = len(stream)
+    return nframes / t_e2e, nframes / t_dev, len(stream)
 
-    fps = nframes / t_e2e
-    device_fps = nframes / t_device
+
+def main() -> None:
+    import jax
+
+    platform = jax.devices()[0].platform
+    qp, gop = 27, 8
+
+    n_1080 = 48
+    fps, dev_fps, nbytes = _run_pipeline(1920, 1080, n_1080, qp, gop)
+
+    n_4k = 16
+    fps_4k, dev_fps_4k, _ = _run_pipeline(3840, 2160, n_4k, qp, gop)
+
     result = {
         "metric": "h264_gop_1080p_fps",
         "value": round(fps, 2),
         "unit": "fps",
         "vs_baseline": round(fps / 30.0, 3),
         "platform": platform,
-        "device_compute_fps": round(device_fps, 2),
-        "bits_per_frame": round(total_bytes * 8 / nframes),
+        "device_gop_fps": round(dev_fps, 2),
+        "fps_2160p": round(fps_4k, 2),
+        "device_gop_fps_2160p": round(dev_fps_4k, 2),
+        "bits_per_frame": round(nbytes * 8 / n_1080),
         "qp": qp,
-        "frames": nframes,
+        "gop_frames": gop,
+        "frames": n_1080,
     }
     print(json.dumps(result))
 

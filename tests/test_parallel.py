@@ -180,6 +180,23 @@ class TestShardedInterDispatch:
                 idr_pic_id=gop.index))
         assert got == b"".join(parts)
 
+    def test_sharded_gop_odd_mb_count(self):
+        # 80x48 -> 5x3 = 15 MBs (odd): the GOP flat level vector length
+        # is then not a multiple of the 16-coeff sparse block, which the
+        # block-granular transfer pack must pad (regression: reshape
+        # crash in _block_sparse_pack for any odd-mb resolution).
+        from thinvids_tpu.codecs.h264.encoder import encode_gop
+
+        n, w, h = 8, 80, 48
+        frames = _make_frames(n, w=w, h=h, seed=3)
+        meta = VideoMeta(width=w, height=h, num_frames=n)
+        got = encode_clip_sharded(frames, meta, qp=27, gop_frames=4)
+        plan = plan_segments(n, 4, len(jax.devices()))
+        parts = [encode_gop(frames[g.start_frame:g.end_frame], meta,
+                            qp=27, idr_pic_id=g.index)
+                 for g in plan.gops]
+        assert got == b"".join(parts)
+
     def test_sharded_gop_oracle_bit_exact(self):
         from thinvids_tpu.tools import oracle
 

@@ -371,23 +371,15 @@ def encode_gop(frames: list[Frame], meta: VideoMeta, qp: int = 27,
     return stream
 
 
-def pack_gop_slices(intra, pouts, num_frames: int, mbw: int, mbh: int,
-                    sps: SPS, pps: PPS, qp: int, idr_pic_id: int,
-                    with_headers: bool = True) -> list[bytes]:
-    """Entropy-pack one GOP's slices from device level arrays.
-
-    The single shared host half of GOP encoding — both the single-device
-    path (encode_gop) and the sharded path (GopShardEncoder._pack_gop)
-    call this, so the bit-identity contract between them cannot drift.
-
-    intra: (luma_dc, luma_ac, chroma_dc, chroma_ac); pouts: the P
-    frames' (mv, luma16, chroma_dc, chroma_ac), leading dim >= num
-    frames - 1 (extra tail-padding entries are ignored).
-    """
-    from . import inter as inter_mod
-
+def _pack_gop_common(intra, pack_p, num_frames: int, mbw: int, mbh: int,
+                     sps: SPS, pps: PPS, qp: int, idr_pic_id: int,
+                     with_headers: bool) -> list[bytes]:
+    """Shared host half of GOP entropy packing: IDR slice from blocked
+    intra levels + one P slice per remaining frame via `pack_p(i,
+    frame_num)`. Every GOP-pack entry point funnels through here so the
+    bit-identity contract between paths cannot drift in the IDR/header
+    logic."""
     il_dc, il_ac, ic_dc, ic_ac = intra
-    mv, l16, cdc, cac = pouts
     luma_mode, chroma_mode = _mode_policy(mbw, mbh)
     intra_levels = FrameLevels(
         luma_mode=luma_mode, chroma_mode=chroma_mode,
@@ -398,7 +390,47 @@ def pack_gop_slices(intra, pouts, num_frames: int, mbw: int, mbh: int,
                                   frame_num=0, idr=True,
                                   idr_pic_id=idr_pic_id % 65536))
     for i in range(num_frames - 1):
-        nals.append(inter_mod.pack_p_slice(
-            mv[i], l16[i], cdc[i], cac[i], mbw, mbh, sps, pps, qp,
-            frame_num=(i + 1) % 256))
+        nals.append(pack_p(i, (i + 1) % 256))
     return nals
+
+
+def pack_gop_slices_planes(intra, planes, num_frames: int, mbw: int,
+                           mbh: int, sps: SPS, pps: PPS, qp: int,
+                           idr_pic_id: int,
+                           with_headers: bool = True) -> list[bytes]:
+    """Entropy-pack one GOP whose P frames arrive as PLANE-layout level
+    arrays (the sharded transfer format, jaxinter.encode_gop_planes):
+    planes = (mv8 (F-1,nmb,2) int8, luma planes (F-1,H,W) int16,
+    u_dc/v_dc (F-1,nmb,4) int16, u_ac/v_ac (F-1,H/2,W/2) int16).
+    The intra frame stays blocked (jaxcore._intra_core emits blocked).
+    Bit-identical to pack_gop_slices on the equivalent blocked arrays."""
+    from . import inter as inter_mod
+
+    mv8, lp, udc, vdc, uac, vac = planes
+    return _pack_gop_common(
+        intra,
+        lambda i, fn: inter_mod.pack_p_slice_plane(
+            mv8[i], lp[i], udc[i], vdc[i], uac[i], vac[i], mbw, mbh,
+            sps, pps, qp, frame_num=fn),
+        num_frames, mbw, mbh, sps, pps, qp, idr_pic_id, with_headers)
+
+
+def pack_gop_slices(intra, pouts, num_frames: int, mbw: int, mbh: int,
+                    sps: SPS, pps: PPS, qp: int, idr_pic_id: int,
+                    with_headers: bool = True) -> list[bytes]:
+    """Entropy-pack one GOP's slices from BLOCKED device level arrays
+    (the single-device encode_gop path).
+
+    intra: (luma_dc, luma_ac, chroma_dc, chroma_ac); pouts: the P
+    frames' (mv, luma16, chroma_dc, chroma_ac), leading dim >= num
+    frames - 1 (extra tail-padding entries are ignored).
+    """
+    from . import inter as inter_mod
+
+    mv, l16, cdc, cac = pouts
+    return _pack_gop_common(
+        intra,
+        lambda i, fn: inter_mod.pack_p_slice(
+            mv[i], l16[i], cdc[i], cac[i], mbw, mbh, sps, pps, qp,
+            frame_num=fn),
+        num_frames, mbw, mbh, sps, pps, qp, idr_pic_id, with_headers)

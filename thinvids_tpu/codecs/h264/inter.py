@@ -107,6 +107,65 @@ def mb_cbp_inter(luma16: np.ndarray, chroma_dc: np.ndarray,
     return cbp_luma, cbp_chroma
 
 
+def blocked_from_planes(luma_plane: np.ndarray, u_ac: np.ndarray,
+                        v_ac: np.ndarray, mbw: int, mbh: int):
+    """Plane-layout coeff planes → the packer's blocked/zigzag arrays
+    (the pure-Python mirror of the native plane packer's internal scan;
+    also the fallback path when no compiler is available)."""
+    from .intra import LUMA_BLOCK_ORDER
+    from .transform import ZIGZAG_4x4
+
+    nmb = mbw * mbh
+    zs = np.asarray([by * 4 + bx for (bx, by) in LUMA_BLOCK_ORDER])
+    zz = np.asarray(ZIGZAG_4x4)
+    x = luma_plane.reshape(mbh, 4, 4, mbw, 4, 4).transpose(0, 3, 1, 4, 2, 5)
+    l16 = x.reshape(nmb, 16, 16)[:, zs][:, :, zz].astype(np.int32)
+    def cblk(p):
+        c = p.reshape(mbh, 2, 4, mbw, 2, 4).transpose(0, 3, 1, 4, 2, 5)
+        return c.reshape(nmb, 4, 16)[..., zz][..., 1:]
+    cac = np.stack([cblk(u_ac), cblk(v_ac)], axis=1).astype(np.int32)
+    return l16, cac
+
+
+def pack_p_slice_plane(mv: np.ndarray, luma_plane: np.ndarray,
+                       u_dc: np.ndarray, v_dc: np.ndarray,
+                       u_ac: np.ndarray, v_ac: np.ndarray,
+                       mbw: int, mbh: int, sps: SPS, pps: PPS, qp: int,
+                       frame_num: int, native: bool | None = None) -> bytes:
+    """Entropy-pack one P picture straight from plane-layout levels.
+
+    mv: (nmb, 2) int; luma_plane: (16*mbh, 16*mbw) int16 quantized
+    coeffs in natural block positions; u_dc/v_dc: (nmb, 4) hadamard-
+    domain DC levels; u_ac/v_ac: (8*mbh, 8*mbw) int16 with DC positions
+    zero. This is the sharded path's pack entry — the device ships raw
+    planes (jaxinter.encode_gop_planes) and no relayout pass exists on
+    either side when the native packer is available.
+    """
+    bw = BitWriter()
+    header = SliceHeader(slice_type=SLICE_TYPE_P, frame_num=frame_num,
+                         idr=False, qp=qp)
+    header.write(bw, sps, pps)
+
+    if native is not False:
+        from ... import native as native_mod
+
+        if native_mod.available():
+            hdr_bytes, hdr_bits = bw.getvalue_unaligned()
+            ebsp = native_mod.pack_pslice_plane(
+                hdr_bytes, hdr_bits, np.asarray(mv, np.int8), luma_plane,
+                u_dc, v_dc, u_ac, v_ac, mbw, mbh)
+            start = b"\x00\x00\x00\x01"
+            nal_header = bytes([(2 << 5) | NAL_SLICE_NON_IDR])
+            return start + nal_header + ebsp
+        if native:
+            raise RuntimeError("native packer requested but unavailable")
+
+    l16, cac = blocked_from_planes(luma_plane, u_ac, v_ac, mbw, mbh)
+    cdc = np.stack([u_dc, v_dc], axis=1).astype(np.int32)
+    return pack_p_slice(np.asarray(mv, np.int32), l16, cdc, cac, mbw, mbh,
+                        sps, pps, qp, frame_num, native=False)
+
+
 def pack_p_slice(mv: np.ndarray, luma16: np.ndarray, chroma_dc: np.ndarray,
                  chroma_ac: np.ndarray, mbw: int, mbh: int, sps: SPS,
                  pps: PPS, qp: int, frame_num: int,

@@ -304,9 +304,16 @@ def _chroma_plane_to_blocks(z, mbw: int, mbh: int):
 
 
 def _encode_p_plane(cy, cu, cv, ry, ru, rv, pred_mv, qp, qpc, *, mbw: int,
-                    mbh: int, sr: int = SEARCH_RANGE):
-    """One P frame given previous recon planes (int16). Returns blocked
-    level arrays (the host packer's layout) + new recon planes (int16).
+                    mbh: int, sr: int = SEARCH_RANGE, blocked: bool = True):
+    """One P frame given previous recon planes (int16).
+
+    `blocked=True` returns level arrays in the host packer's blocked
+    layout (the conformance/host path). `blocked=False` skips the
+    device-side relayout entirely and returns raw coefficient PLANES —
+    the sharded transfer path's format; the relayout then happens on
+    host inside the pack pool (measured: the blocked transposes +
+    zigzag gathers cost ~0.5 s per 1080p GOP on a v5e chip, twice the
+    rest of the GOP's compute).
     """
     H, W = cy.shape
     n = mbw * mbh
@@ -330,8 +337,11 @@ def _encode_p_plane(cy, cu, cv, ry, ru, rv, pred_mv, qp, qpc, *, mbw: int,
     d = _dequant_plane(z, v_y, qp32)
     recon_y = jnp.clip((_inv4_plane(d) + 32 >> 6) + pred_y, 0, 255
                        ).astype(jnp.int16)
-    luma_levels = _luma_plane_to_blocks(z.astype(jnp.int16), mbw, mbh
-                                        ).astype(jnp.int32)
+    if blocked:
+        luma_levels = _luma_plane_to_blocks(z.astype(jnp.int16), mbw, mbh
+                                            ).astype(jnp.int32)
+    else:
+        luma_levels = z.astype(jnp.int16)               # (H, W) coeff plane
 
     # --- chroma: AC plane + 2x2 hadamard DC per MB ---
     def chroma(cplane16, pred, mf_c, v_c):
@@ -370,15 +380,22 @@ def _encode_p_plane(cy, cu, cv, ry, ru, rv, pred_mv, qp, qpc, *, mbw: int,
         dfull = dfull.reshape(h, wd_)
         rec = jnp.clip((_inv4_plane(dfull) + 32 >> 6) + pred, 0, 255
                        ).astype(jnp.int16)
-        ac = _chroma_plane_to_blocks(zac.astype(jnp.int16), mbw, mbh
-                                     )[..., 1:].astype(jnp.int32)
+        if blocked:
+            ac = _chroma_plane_to_blocks(zac.astype(jnp.int16), mbw, mbh
+                                         )[..., 1:].astype(jnp.int32)
+        else:
+            ac = zac.astype(jnp.int16)                  # (H/2, W/2) plane
         dc_lev = zdc.reshape(n, 4)
         return dc_lev, ac, rec
 
     udc, uac, recon_u = chroma(cu16, pred_u, mf_c, v_c)
     vdc, vac, recon_v = chroma(cv16, pred_v, mf_c, v_c)
-    chroma_dc = jnp.stack([udc, vdc], axis=1)            # (n, 2, 4)
-    chroma_ac = jnp.stack([uac, vac], axis=1)            # (n, 2, 4, 15)
+    if blocked:
+        chroma_dc = jnp.stack([udc, vdc], axis=1)        # (n, 2, 4)
+        chroma_ac = jnp.stack([uac, vac], axis=1)        # (n, 2, 4, 15)
+    else:
+        chroma_dc = jnp.stack([udc, vdc]).astype(jnp.int16)  # (2, n, 4)
+        chroma_ac = jnp.stack([uac, vac])                # (2, H/2, W/2)
 
     med_mv = jnp.median(mv.reshape(-1, 2), axis=0).astype(jnp.int32)
     return (mv.reshape(n, 2), luma_levels, chroma_dc, chroma_ac,
@@ -428,3 +445,62 @@ def encode_gop_jit(ys, us, vs, qp, *, mbw: int, mbh: int,
         return intra, (mv, l16, cdc, cac), (recon_y, recon_u, recon_v)
     mv, l16, cdc, cac = pouts
     return intra, (mv, l16, cdc, cac)
+
+
+# Per-MB flat sizes for the plane-layout GOP transfer: the P part of the
+# flat vector is (F-1) * nmb * _P_FLAT_MB int16 values laid out
+# struct-of-arrays: all luma coeff planes, then u DC, v DC (hadamard
+# domain), then u AC, v AC coeff planes (DC positions zeroed).
+_P_FLAT_MB = 256 + 4 + 4 + 64 + 64        # = 392
+_INTRA_FLAT_MB = 384
+
+
+def encode_gop_planes(ys, us, vs, qp, *, mbw: int, mbh: int):
+    """Closed-GOP compute emitting PLANE-layout levels for the sharded
+    transfer path: returns (mv (F-1, nmb, 2) int8, flat int16).
+
+    flat layout (all reshape(-1), no relayout on device):
+      [ intra il_dc | il_ac | ic_dc | ic_ac          (nmb * 384)
+      | luma coeff planes   (F-1, H, W)
+      | u DC (F-1, nmb, 4) | v DC (F-1, nmb, 4)
+      | u AC plane (F-1, H/2, W/2) | v AC plane (F-1, H/2, W/2) ]
+
+    The host inverse is parallel/dispatch._unflatten_gop.
+    """
+    # The int8 MV transfer rides on search candidates being bounded by
+    # construction: centers clamp to ±(sr - _WIN_RAD) and offsets add
+    # ≤ _WIN_RAD, so |mv| ≤ SEARCH_RANGE per frame (each P frame
+    # references its immediate predecessor — MVs never accumulate).
+    if SEARCH_RANGE > 127:
+        raise ValueError("SEARCH_RANGE exceeds the int8 MV transfer")
+    qp = qp.astype(jnp.int32)
+    qpc = _QPC[jnp.clip(qp, 0, 51)]
+    (il_dc, il_ac, ic_dc, ic_ac, ry, ru, rv) = _intra_core(
+        ys[0], us[0], vs[0], qp, mbw=mbw, mbh=mbh)
+    ry = ry.astype(jnp.int16)
+    ru = ru.astype(jnp.int16)
+    rv = rv.astype(jnp.int16)
+
+    def p_step(carry, xs):
+        ry, ru, rv, pred_mv = carry
+        cy, cu, cv = xs
+        (mv, lp, cdc, cac, ry2, ru2, rv2, med_mv) = _encode_p_plane(
+            cy, cu, cv, ry, ru, rv, pred_mv, qp, qpc, mbw=mbw, mbh=mbh,
+            blocked=False)
+        return (ry2, ru2, rv2, med_mv), (mv.astype(jnp.int8), lp, cdc, cac)
+
+    zero = _varying_zero(ry)
+    zero_mv = jnp.zeros(2, jnp.int32) + zero
+    _, (mv8, lps, cdcs, cacs) = jax.lax.scan(
+        p_step, (ry, ru, rv, zero_mv), (ys[1:], us[1:], vs[1:]))
+    # cdcs: (F-1, 2, n, 4) int16; cacs: (F-1, 2, H/2, W/2) int16
+    flat = jnp.concatenate([
+        il_dc.reshape(-1).astype(jnp.int16),
+        il_ac.reshape(-1).astype(jnp.int16),
+        ic_dc.reshape(-1).astype(jnp.int16),
+        ic_ac.reshape(-1).astype(jnp.int16),
+        lps.reshape(-1),
+        cdcs[:, 0].reshape(-1), cdcs[:, 1].reshape(-1),
+        cacs[:, 0].reshape(-1), cacs[:, 1].reshape(-1),
+    ])
+    return mv8, flat

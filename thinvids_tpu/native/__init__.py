@@ -95,13 +95,27 @@ def _build_and_load() -> ctypes.CDLL:
             ctypes.c_int32, ctypes.c_int32,             # mbw, mbh
             ctypes.c_void_p, ctypes.c_int64,            # out, cap
         ]
+        lib.cavlc_init_scan.argtypes = [ctypes.c_void_p]
+        lib.cavlc_pack_pslice_plane.restype = ctypes.c_int64
+        lib.cavlc_pack_pslice_plane.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32,            # header bytes, bitlen
+            ctypes.c_void_p,                            # mv int8
+            ctypes.c_void_p,                            # luma plane int16
+            ctypes.c_void_p, ctypes.c_void_p,           # u/v DC int16
+            ctypes.c_void_p, ctypes.c_void_p,           # u/v AC planes int16
+            ctypes.c_int32, ctypes.c_int32,             # mbw, mbh
+            ctypes.c_void_p, ctypes.c_int64,            # out, cap
+        ]
         arrs = _marshal_tables()
         from ..codecs.h264.inter import CBP_INTER_TO_CODE
+        from ..codecs.h264.transform import ZIGZAG_4x4
 
         cbp_inter = np.asarray(CBP_INTER_TO_CODE, np.int32)
-        lib._table_refs = arrs + (cbp_inter,)  # keep alive
+        zz = np.asarray(ZIGZAG_4x4, np.int32)
+        lib._table_refs = arrs + (cbp_inter, zz)  # keep alive
         lib.cavlc_init_tables(*(a.ctypes.data for a in arrs))
         lib.cavlc_init_inter(cbp_inter.ctypes.data)
+        lib.cavlc_init_scan(zz.ctypes.data)
         _lib = lib
         return lib
 
@@ -146,6 +160,48 @@ def pack_islice(header_bytes: bytes, header_bit_len: int,
         luma_mode.ctypes.data, chroma_mode.ctypes.data,
         luma_dc.ctypes.data, luma_ac.ctypes.data,
         chroma_dc.ctypes.data, chroma_ac.ctypes.data,
+        mbw, mbh, out.ctypes.data, cap)
+    if n == -2:
+        raise RuntimeError("native packer output buffer overflow")
+    if n == -3:
+        raise ValueError("level too large for baseline CAVLC")
+    if n < 0:
+        raise RuntimeError(f"native packer failed ({n})")
+    return out[:n].tobytes()
+
+
+def pack_pslice_plane(header_bytes: bytes, header_bit_len: int,
+                      mv8: np.ndarray, luma_plane: np.ndarray,
+                      u_dc: np.ndarray, v_dc: np.ndarray,
+                      u_ac: np.ndarray, v_ac: np.ndarray,
+                      mbw: int, mbh: int) -> bytes:
+    """Pack one P-slice straight from plane-layout int16 level arrays
+    (zigzag/z-scan happens inside the C++ via the shared scan table) —
+    bit-identical to pack_pslice on the equivalent blocked arrays."""
+    lib = _build_and_load()
+    nmb = mbw * mbh
+
+    def prep(a, shape, dtype):
+        a = np.ascontiguousarray(a, dtype)
+        if a.shape != shape:
+            raise ValueError(f"bad array shape {a.shape}, want {shape}")
+        return a
+
+    mv8 = prep(mv8, (nmb, 2), np.int8)
+    luma_plane = prep(luma_plane, (16 * mbh, 16 * mbw), np.int16)
+    u_dc = prep(u_dc, (nmb, 4), np.int16)
+    v_dc = prep(v_dc, (nmb, 4), np.int16)
+    u_ac = prep(u_ac, (8 * mbh, 8 * mbw), np.int16)
+    v_ac = prep(v_ac, (8 * mbh, 8 * mbw), np.int16)
+
+    cap = max(8192, nmb * 4096)
+    out = np.empty(cap, np.uint8)
+    hdr = np.frombuffer(header_bytes, np.uint8)
+    n = lib.cavlc_pack_pslice_plane(
+        hdr.ctypes.data, header_bit_len,
+        mv8.ctypes.data, luma_plane.ctypes.data,
+        u_dc.ctypes.data, v_dc.ctypes.data,
+        u_ac.ctypes.data, v_ac.ctypes.data,
         mbw, mbh, out.ctypes.data, cap)
     if n == -2:
         raise RuntimeError("native packer output buffer overflow")

@@ -288,39 +288,23 @@ static inline int32_t median3(int32_t a, int32_t b, int32_t c) {
   return c < mn ? mn : (c > mx ? mx : c);
 }
 
-// Packs one P picture (all-inter, P_L0_16x16 / P_Skip, single reference,
-// integer-pel MVs). mv: nmb*2 as (dy, dx); luma16: nmb*16*16 z-scan blocks
-// of 16 zig-zag coeffs. Mirrors codecs/h264/inter.pack_p_slice bit-for-bit.
-int64_t cavlc_pack_pslice(
-    const uint8_t* header_bytes, int32_t header_bit_len,
-    const int32_t* mv,
-    const int32_t* luma16,
-    const int32_t* chroma_dc,
-    const int32_t* chroma_ac,
-    int32_t mbw, int32_t mbh, uint8_t* out, int64_t out_cap) {
-  if (!g_tables_ready || !g_inter_ready || mbw <= 0 || mbh <= 0) return -1;
-  static const int BX[16] = {0, 1, 0, 1, 2, 3, 2, 3, 0, 1, 0, 1, 2, 3, 2, 3};
-  static const int BY[16] = {0, 0, 1, 1, 0, 0, 1, 1, 2, 2, 3, 3, 2, 2, 3, 3};
-  static const int CBX[4] = {0, 1, 0, 1};
-  static const int CBY[4] = {0, 0, 1, 1};
-
+// MV prediction (median, C->D fallback) + P_Skip predictor, §8.4.1.3/1.1.
+// Shared by the blocked and plane-layout P-slice packers — their
+// bit-identity contract rides on this being the single implementation.
+static void compute_mv_pred(const int32_t* mv, int mbw, int mbh,
+                            std::vector<int32_t>& mvp,
+                            std::vector<int32_t>& skipmv) {
   const int nmb = mbw * mbh;
-  BitWriter bw;
-  bw.buf.reserve((size_t)nmb * 16);
-  for (int i = 0; i < header_bit_len / 8; i++) bw.write(header_bytes[i], 8);
-  if (int rem = header_bit_len % 8)
-    bw.write(header_bytes[header_bit_len / 8] >> (8 - rem), rem);
-
-  // MV prediction (median, C->D fallback) + P_Skip predictor, §8.4.1.3/1.1.
-  std::vector<int32_t> mvp((size_t)nmb * 2), skipmv((size_t)nmb * 2);
+  mvp.resize((size_t)nmb * 2);
+  skipmv.resize((size_t)nmb * 2);
   for (int my = 0; my < mbh; my++) {
     for (int mx = 0; mx < mbw; mx++) {
       const int mi = my * mbw + mx;
       const bool avail_a = mx > 0, avail_b = my > 0;
-      const int32_t* mva_p = avail_a ? mv + (size_t)(mi - 1) * 2 : nullptr;
-      const int32_t* mvb_p = avail_b ? mv + (size_t)(mi - mbw) * 2 : nullptr;
-      int32_t mva[2] = {avail_a ? mva_p[0] : 0, avail_a ? mva_p[1] : 0};
-      int32_t mvb[2] = {avail_b ? mvb_p[0] : 0, avail_b ? mvb_p[1] : 0};
+      int32_t mva[2] = {avail_a ? mv[(size_t)(mi - 1) * 2] : 0,
+                        avail_a ? mv[(size_t)(mi - 1) * 2 + 1] : 0};
+      int32_t mvb[2] = {avail_b ? mv[(size_t)(mi - mbw) * 2] : 0,
+                        avail_b ? mv[(size_t)(mi - mbw) * 2 + 1] : 0};
       int32_t mvc[2] = {0, 0};
       bool avail_c = false;
       if (my > 0 && mx + 1 < mbw) {
@@ -356,6 +340,33 @@ int64_t cavlc_pack_pslice(
       }
     }
   }
+}
+
+// Packs one P picture (all-inter, P_L0_16x16 / P_Skip, single reference,
+// integer-pel MVs). mv: nmb*2 as (dy, dx); luma16: nmb*16*16 z-scan blocks
+// of 16 zig-zag coeffs. Mirrors codecs/h264/inter.pack_p_slice bit-for-bit.
+int64_t cavlc_pack_pslice(
+    const uint8_t* header_bytes, int32_t header_bit_len,
+    const int32_t* mv,
+    const int32_t* luma16,
+    const int32_t* chroma_dc,
+    const int32_t* chroma_ac,
+    int32_t mbw, int32_t mbh, uint8_t* out, int64_t out_cap) {
+  if (!g_tables_ready || !g_inter_ready || mbw <= 0 || mbh <= 0) return -1;
+  static const int BX[16] = {0, 1, 0, 1, 2, 3, 2, 3, 0, 1, 0, 1, 2, 3, 2, 3};
+  static const int BY[16] = {0, 0, 1, 1, 0, 0, 1, 1, 2, 2, 3, 3, 2, 2, 3, 3};
+  static const int CBX[4] = {0, 1, 0, 1};
+  static const int CBY[4] = {0, 0, 1, 1};
+
+  const int nmb = mbw * mbh;
+  BitWriter bw;
+  bw.buf.reserve((size_t)nmb * 16);
+  for (int i = 0; i < header_bit_len / 8; i++) bw.write(header_bytes[i], 8);
+  if (int rem = header_bit_len % 8)
+    bw.write(header_bytes[header_bit_len / 8] >> (8 - rem), rem);
+
+  std::vector<int32_t> mvp, skipmv;
+  compute_mv_pred(mv, mbw, mbh, mvp, skipmv);
 
   const int lw = 4 * mbw, lh = 4 * mbh;
   const int cw = 2 * mbw, ch = 2 * mbh;
@@ -440,6 +451,181 @@ int64_t cavlc_pack_pslice(
   bw.trailing();
 
   return emit_ebsp(bw, out, out_cap);
+}
+
+// ---- plane-layout P-slice packer -------------------------------------------
+//
+// The sharded transfer path ships raw quantized coefficient PLANES (the
+// device-side blocked relayout measured ~0.5 s/GOP on TPU, and the host
+// numpy equivalent ~0.2 s/GOP on the 1-core host — parallel/dispatch.py).
+// This variant reads coefficients straight from the planes through the
+// zig-zag offset table, so no relayout pass exists anywhere.
+
+static int32_t g_zz[16];      // zigzag position -> raster index in a 4x4
+static bool g_scan_ready = false;
+
+void cavlc_init_scan_impl(const int32_t* zz) {
+  std::memcpy(g_zz, zz, sizeof(g_zz));
+  g_scan_ready = true;
+}
+
+// Packs one P picture from plane-layout levels. mv: nmb*2 int8 (dy, dx);
+// luma_plane: (16*mbh)x(16*mbw) int16; u_dc/v_dc: nmb*4 int16 (hadamard
+// domain); u_ac/v_ac: (8*mbh)x(8*mbw) int16 with DC positions zero.
+// Bit-identical to cavlc_pack_pslice on the equivalent blocked arrays.
+int64_t cavlc_pack_pslice_plane_impl(
+    const uint8_t* header_bytes, int32_t header_bit_len,
+    const int8_t* mv8,
+    const int16_t* luma_plane,
+    const int16_t* u_dc, const int16_t* v_dc,
+    const int16_t* u_ac, const int16_t* v_ac,
+    int32_t mbw, int32_t mbh, uint8_t* out, int64_t out_cap) {
+  if (!g_tables_ready || !g_inter_ready || !g_scan_ready
+      || mbw <= 0 || mbh <= 0)
+    return -1;
+  static const int BX[16] = {0, 1, 0, 1, 2, 3, 2, 3, 0, 1, 0, 1, 2, 3, 2, 3};
+  static const int BY[16] = {0, 0, 1, 1, 0, 0, 1, 1, 2, 2, 3, 3, 2, 2, 3, 3};
+  static const int CBX[4] = {0, 1, 0, 1};
+  static const int CBY[4] = {0, 0, 1, 1};
+
+  const int nmb = mbw * mbh;
+  const int W = 16 * mbw;
+  const int CW = 8 * mbw;
+  BitWriter bw;
+  bw.buf.reserve((size_t)nmb * 16);
+  for (int i = 0; i < header_bit_len / 8; i++) bw.write(header_bytes[i], 8);
+  if (int rem = header_bit_len % 8)
+    bw.write(header_bytes[header_bit_len / 8] >> (8 - rem), rem);
+
+  std::vector<int32_t> mv((size_t)nmb * 2);
+  for (size_t i = 0; i < (size_t)nmb * 2; i++) mv[i] = mv8[i];
+
+  std::vector<int32_t> mvp, skipmv;
+  compute_mv_pred(mv.data(), mbw, mbh, mvp, skipmv);
+
+  const int lw = 4 * mbw, lh = 4 * mbh;
+  const int cw = 2 * mbw, ch = 2 * mbh;
+  std::vector<int32_t> lcnt((size_t)lw * lh, 0);
+  std::vector<int32_t> ccnt((size_t)2 * cw * ch, 0);
+  auto luma_nc = [&](int gy, int gx) {
+    return nc_from_counts(lcnt.data(), lw, gy, gx);
+  };
+  auto chroma_nc = [&](int ci, int gy, int gx) {
+    return nc_from_counts(ccnt.data() + (size_t)ci * ch * cw, cw, gy, gx);
+  };
+
+  uint32_t skip_run = 0;
+  int32_t l16[16][16];       // per-MB luma blocks, zigzag order
+  int32_t cacl[2][4][15];    // per-MB chroma AC blocks, zigzag[1:]
+  int32_t cdcl[2][4];
+  for (int my = 0; my < mbh; my++) {
+    for (int mx = 0; mx < mbw; mx++) {
+      const int mi = my * mbw + mx;
+
+      // gather this MB's coefficients from the planes (zigzag order)
+      for (int bi = 0; bi < 16; bi++) {
+        const int r0 = my * 16 + BY[bi] * 4;
+        const int c0 = mx * 16 + BX[bi] * 4;
+        for (int k = 0; k < 16; k++) {
+          const int zz = g_zz[k];
+          l16[bi][k] = luma_plane[(size_t)(r0 + (zz >> 2)) * W + c0 + (zz & 3)];
+        }
+      }
+      for (int ci = 0; ci < 2; ci++) {
+        const int16_t* plane = ci == 0 ? u_ac : v_ac;
+        const int16_t* dc = ci == 0 ? u_dc : v_dc;
+        for (int bi = 0; bi < 4; bi++) {
+          const int r0 = my * 8 + CBY[bi] * 4;
+          const int c0 = mx * 8 + CBX[bi] * 4;
+          for (int k = 1; k < 16; k++) {
+            const int zz = g_zz[k];
+            cacl[ci][bi][k - 1] =
+                plane[(size_t)(r0 + (zz >> 2)) * CW + c0 + (zz & 3)];
+          }
+        }
+        for (int j = 0; j < 4; j++) cdcl[ci][j] = dc[(size_t)mi * 4 + j];
+      }
+
+      int cbp_luma = 0;
+      for (int g = 0; g < 4; g++)
+        for (int bi = g * 4; bi < g * 4 + 4 && !(cbp_luma & (1 << g)); bi++)
+          for (int k = 0; k < 16; k++)
+            if (l16[bi][k]) { cbp_luma |= 1 << g; break; }
+      int cbp_chroma = 0;
+      for (int ci = 0; ci < 2 && cbp_chroma < 2; ci++)
+        for (int bi = 0; bi < 4 && cbp_chroma < 2; bi++)
+          for (int k = 0; k < 15; k++)
+            if (cacl[ci][bi][k]) { cbp_chroma = 2; break; }
+      if (cbp_chroma == 0)
+        for (int ci = 0; ci < 2 && !cbp_chroma; ci++)
+          for (int j = 0; j < 4; j++)
+            if (cdcl[ci][j]) { cbp_chroma = 1; break; }
+      const int cbp = cbp_luma | (cbp_chroma << 4);
+
+      const bool is_skip = cbp == 0
+          && mv[(size_t)mi * 2] == skipmv[(size_t)mi * 2]
+          && mv[(size_t)mi * 2 + 1] == skipmv[(size_t)mi * 2 + 1];
+      if (is_skip) {
+        skip_run++;
+        continue;
+      }
+      bw.ue(skip_run);
+      skip_run = 0;
+      bw.ue(0);   // mb_type = P_L0_16x16
+      bw.se(4 * (mv[(size_t)mi * 2 + 1] - mvp[(size_t)mi * 2 + 1]));
+      bw.se(4 * (mv[(size_t)mi * 2] - mvp[(size_t)mi * 2]));
+      bw.ue((uint32_t)g_cbp_inter[cbp]);
+      if (cbp) bw.se(0);   // mb_qp_delta
+
+      const int by0 = 4 * my, bx0 = 4 * mx;
+      for (int bi = 0; bi < 16; bi++) {
+        int gy = by0 + BY[bi], gx = bx0 + BX[bi];
+        if (cbp_luma & (1 << (bi / 4))) {
+          int tc = encode_residual(bw, l16[bi], 16, luma_nc(gy, gx));
+          if (tc < 0) return -3;
+          lcnt[(size_t)gy * lw + gx] = tc;
+        } else {
+          lcnt[(size_t)gy * lw + gx] = 0;
+        }
+      }
+      if (cbp_chroma > 0)
+        for (int ci = 0; ci < 2; ci++)
+          if (encode_residual(bw, cdcl[ci], 4, -1) < 0)
+            return -3;
+      const int cy0 = 2 * my, cx0 = 2 * mx;
+      for (int ci = 0; ci < 2; ci++) {
+        for (int bi = 0; bi < 4; bi++) {
+          int gy = cy0 + CBY[bi], gx = cx0 + CBX[bi];
+          if (cbp_chroma == 2) {
+            int tc = encode_residual(bw, cacl[ci][bi], 15,
+                                     chroma_nc(ci, gy, gx));
+            if (tc < 0) return -3;
+            ccnt[((size_t)ci * ch + gy) * cw + gx] = tc;
+          } else {
+            ccnt[((size_t)ci * ch + gy) * cw + gx] = 0;
+          }
+        }
+      }
+    }
+  }
+  if (skip_run) bw.ue(skip_run);
+  bw.trailing();
+
+  return emit_ebsp(bw, out, out_cap);
+}
+
+void cavlc_init_scan(const int32_t* zz) { cavlc_init_scan_impl(zz); }
+
+int64_t cavlc_pack_pslice_plane(
+    const uint8_t* header_bytes, int32_t header_bit_len,
+    const int8_t* mv8,
+    const int16_t* luma_plane,
+    const int16_t* u_dc, const int16_t* v_dc,
+    const int16_t* u_ac, const int16_t* v_ac,
+    int32_t mbw, int32_t mbh, uint8_t* out, int64_t out_cap) {
+  return cavlc_pack_pslice_plane_impl(
+      header_bytes, header_bit_len, mv8, luma_plane, u_dc, v_dc, u_ac,
+      v_ac, mbw, mbh, out, out_cap);
 }
 
 }  // extern "C"

@@ -25,6 +25,12 @@ from ..core.types import EncodedSegment, Frame, GopSpec, SegmentPlan, VideoMeta
 from ..codecs.h264.encoder import pack_slice
 from ..codecs.h264.headers import PPS, SPS
 from ..codecs.h264 import jaxcore
+# Per-MB flat sizes, owned by jaxinter next to the layout they describe
+# (intra: luma_dc 16 + luma_ac 240 + chroma 128; P plane layout: luma
+# plane 256 + chroma DC 8 + chroma AC planes 128 — MVs ride separately
+# as int8).
+from ..codecs.h264.jaxinter import _INTRA_FLAT_MB as _INTRA_MB
+from ..codecs.h264.jaxinter import _P_FLAT_MB
 from .planner import plan_segments
 
 
@@ -39,64 +45,92 @@ def _flat_levels(y, u, v, qp, mbw, mbh):
         ldc.reshape(-1), lac.reshape(-1), cdc.reshape(-1), cac.reshape(-1)])
 
 
-# Per-MB flat sizes: intra frame (luma_dc 16 + luma_ac 240 + chroma 128)
-# and P frame (mv 2 + luma16 256 + chroma_dc 8 + chroma_ac 120).
-_INTRA_MB = 384
-_P_MB = 386
-
-
-def _gop_flat_levels(ys, us, vs, qp, mbw, mbh):
-    """(F, H, W) GOP → one flat int32 level vector:
-    [intra | P1(mv, luma16, cdc, cac) | P2 ...]."""
+def _per_gop_sparse(y, u, v, qp, mbw: int, mbh: int):
+    """(F, H, W) GOP → (mv int8, block-sparse plane-layout levels)."""
     from ..codecs.h264 import jaxinter
 
-    intra, pouts = jaxinter.encode_gop_jit(ys, us, vs, qp, mbw=mbw, mbh=mbh)
-    il_dc, il_ac, ic_dc, ic_ac = intra
-    mv, l16, cdc, cac = pouts          # leading dim F-1
-    fm1 = mv.shape[0]
-    per_p = jnp.concatenate([
-        mv.reshape(fm1, -1), l16.reshape(fm1, -1),
-        cdc.reshape(fm1, -1), cac.reshape(fm1, -1)], axis=1)
-    return jnp.concatenate([
-        il_dc.reshape(-1), il_ac.reshape(-1),
-        ic_dc.reshape(-1), ic_ac.reshape(-1), per_p.reshape(-1)])
+    mv8, flat = jaxinter.encode_gop_planes(y, u, v, qp, mbw=mbw, mbh=mbh)
+    return (mv8,) + jaxcore._block_sparse_pack(flat)
 
 
-def _unflatten_gop(flat: np.ndarray, num_frames: int, mbw: int, mbh: int):
-    """Inverse of _gop_flat_levels on host."""
+def _per_gop_dense(y, u, v, qp, mbw: int, mbh: int, dtype):
+    from ..codecs.h264 import jaxinter
+
+    _mv8, flat = jaxinter.encode_gop_planes(y, u, v, qp, mbw=mbw, mbh=mbh)
+    return flat.astype(dtype)
+
+
+def _unflatten_gop(flat: np.ndarray, mv8: np.ndarray, num_frames: int,
+                   mbw: int, mbh: int):
+    """Host inverse of jaxinter.encode_gop_planes: split the flat int16
+    vector into (intra blocked arrays, P plane views). Every P-frame
+    array is a VIEW — the plane->blocked scan happens inside the native
+    packer (cavlc_pack_pslice_plane), so no relayout pass runs here."""
     nmb = mbw * mbh
-    o = nmb * 16
-    il_dc = flat[:o].reshape(nmb, 16)
-    il_ac = flat[o:o + nmb * 240].reshape(nmb, 16, 15)
+    H, W = mbh * 16, mbw * 16
+    hw2 = (H // 2) * (W // 2)
+    flat = np.asarray(flat)
+    o = 0
+    il_dc = flat[o:o + nmb * 16].reshape(nmb, 16).astype(np.int32)
+    o += nmb * 16
+    il_ac = flat[o:o + nmb * 240].reshape(nmb, 16, 15).astype(np.int32)
     o += nmb * 240
-    ic_dc = flat[o:o + nmb * 8].reshape(nmb, 2, 4)
+    ic_dc = flat[o:o + nmb * 8].reshape(nmb, 2, 4).astype(np.int32)
     o += nmb * 8
-    ic_ac = flat[o:o + nmb * 120].reshape(nmb, 2, 4, 15)
+    ic_ac = flat[o:o + nmb * 120].reshape(nmb, 2, 4, 15).astype(np.int32)
     o += nmb * 120
-    p = flat[o:].reshape(num_frames - 1, nmb * _P_MB) \
-        if num_frames > 1 else np.zeros((0, nmb * _P_MB), flat.dtype)
-    mv = p[:, :nmb * 2].reshape(-1, nmb, 2)
-    l16 = p[:, nmb * 2:nmb * 258].reshape(-1, nmb, 16, 16)
-    cdc = p[:, nmb * 258:nmb * 266].reshape(-1, nmb, 2, 4)
-    cac = p[:, nmb * 266:].reshape(-1, nmb, 2, 4, 15)
-    return (il_dc, il_ac, ic_dc, ic_ac), (mv, l16, cdc, cac)
+    F1 = num_frames - 1
+    lp = flat[o:o + F1 * H * W].reshape(F1, H, W)
+    o += F1 * H * W
+    udc = flat[o:o + F1 * nmb * 4].reshape(F1, nmb, 4)
+    o += F1 * nmb * 4
+    vdc = flat[o:o + F1 * nmb * 4].reshape(F1, nmb, 4)
+    o += F1 * nmb * 4
+    uac = flat[o:o + F1 * hw2].reshape(F1, H // 2, W // 2)
+    o += F1 * hw2
+    vac = flat[o:o + F1 * hw2].reshape(F1, H // 2, W // 2)
+    mv = np.asarray(mv8)
+    return ((il_dc, il_ac, ic_dc, ic_ac), (mv, lp, udc, vdc, uac, vac))
 
 
 @functools.partial(jax.jit, static_argnames=("mbw", "mbh", "mesh"))
 def _encode_wave_gop(ys, us, vs, qp, *, mbw: int, mbh: int, mesh: Mesh):
-    """ys: (G, F, H, W) uint8 sharded over `gop`; each device encodes its
-    GOP as IDR + P frames (jaxinter) and sparse-packs the flat levels."""
+    """ys: (G, F, H, W) uint8 sharded over `gop`, G = devices x k; each
+    device sequentially encodes its k GOPs (IDR + P, jaxinter) and
+    sparse-packs the plane-layout levels."""
 
-    def per_gop(y_g, u_g, v_g):
-        flat = _gop_flat_levels(y_g[0], u_g[0], v_g[0], qp, mbw, mbh)
-        return tuple(x[None] for x in jaxcore._sparse_pack(flat))
+    def per_dev(y_g, u_g, v_g):
+        def one(args):
+            y, u, v = args
+            return _per_gop_sparse(y, u, v, qp, mbw, mbh)
+        return jax.lax.map(one, (y_g, u_g, v_g))
 
     shard = jax.shard_map(
-        per_gop, mesh=mesh,
+        per_dev, mesh=mesh,
         in_specs=(P("gop"), P("gop"), P("gop")),
-        out_specs=(P("gop"),) * 6,
+        out_specs=(P("gop"),) * 7,
     )
     return shard(ys, us, vs)
+
+
+@functools.partial(jax.jit, static_argnames=("mbw", "mbh"))
+def _encode_gop_single(ys, us, vs, qp, *, mbw: int, mbh: int):
+    """Single-device wave: the same per-GOP program WITHOUT the
+    shard_map wrapper. On one chip shard_map buys nothing and costs a
+    lot — measured on TPU v5e: compile 33 s → 810 s and steady-state
+    256 ms → 800 ms per 1080p GOP under the manual-axes lowering."""
+    def one(args):
+        y, u, v = args
+        return _per_gop_sparse(y, u, v, qp, mbw, mbh)
+    return jax.lax.map(one, (ys, us, vs))
+
+
+@functools.partial(jax.jit, static_argnames=("mbw", "mbh", "dtype"))
+def _encode_gop_single_dense(ys, us, vs, qp, *, mbw: int, mbh: int, dtype):
+    def one(args):
+        y, u, v = args
+        return _per_gop_dense(y, u, v, qp, mbw, mbh, dtype)
+    return jax.lax.map(one, (ys, us, vs))
 
 
 @functools.partial(jax.jit, static_argnames=("mbw", "mbh", "mesh", "dtype"))
@@ -104,12 +138,14 @@ def _encode_wave_gop_dense(ys, us, vs, qp, *, mbw: int, mbh: int, mesh: Mesh,
                            dtype):
     """Dense fallback for the GOP wave: (G, L) levels in `dtype`."""
 
-    def per_gop(y_g, u_g, v_g):
-        flat = _gop_flat_levels(y_g[0], u_g[0], v_g[0], qp, mbw, mbh)
-        return flat[None].astype(dtype)
+    def per_dev(y_g, u_g, v_g):
+        def one(args):
+            y, u, v = args
+            return _per_gop_dense(y, u, v, qp, mbw, mbh, dtype)
+        return jax.lax.map(one, (y_g, u_g, v_g))
 
     shard = jax.shard_map(
-        per_gop, mesh=mesh,
+        per_dev, mesh=mesh,
         in_specs=(P("gop"), P("gop"), P("gop")),
         out_specs=P("gop"),
     )
@@ -173,7 +209,7 @@ class GopShardEncoder:
 
     def __init__(self, meta: VideoMeta, qp: int = 27, mesh: Mesh | None = None,
                  gop_frames: int = 32, max_segments: int = 200,
-                 inter: bool = True):
+                 inter: bool = True, gops_per_wave: int = 4):
         self.meta = meta
         self.qp = qp
         #: inter=True encodes each GOP as IDR + P frames (motion-coded);
@@ -182,6 +218,10 @@ class GopShardEncoder:
         self.mesh = mesh if mesh is not None else default_mesh()
         self.gop_frames = gop_frames
         self.max_segments = max_segments
+        #: GOPs encoded per device per wave (lax.map'd inside one
+        #: program) — batches device dispatch + transfer so per-call
+        #: host<->device latency amortizes. Inter path only.
+        self.gops_per_wave = max(1, int(gops_per_wave))
         self.sps = SPS(width=meta.width, height=meta.height,
                        fps_num=meta.fps_num, fps_den=meta.fps_den)
         self.pps = PPS(init_qp=qp)
@@ -212,14 +252,17 @@ class GopShardEncoder:
         plan = self.plan(len(frames))
         padded = [f.padded(16) for f in frames]
         D = self.num_devices
+        per_wave = D * (self.gops_per_wave if self.inter else 1)
         gops = list(plan.gops)
-        for wave_start in range(0, len(gops), D):
-            wave = gops[wave_start:wave_start + D]
+        for wave_start in range(0, len(gops), per_wave):
+            wave = gops[wave_start:wave_start + per_wave]
             F = max(g.num_frames for g in wave)
             # Stack into (G, F, ...) with tail-repeat padding to static F,
-            # and pad the wave itself to D gops (encoded then discarded).
+            # and pad the wave itself to a multiple of D gops (the pad
+            # GOPs are encoded then discarded).
             pad_gop = wave[-1]
-            full = wave + [pad_gop] * (D - len(wave))
+            pad_n = (-len(wave)) % D
+            full = wave + [pad_gop] * pad_n
             ys = np.stack([self._gop_plane(padded, g, F, "y") for g in full])
             us = np.stack([self._gop_plane(padded, g, F, "u") for g in full])
             vs = np.stack([self._gop_plane(padded, g, F, "v") for g in full])
@@ -241,8 +284,20 @@ class GopShardEncoder:
         qp = self._qp_arr
         ph, pw = ysd.shape[2], ysd.shape[3]
         mbh, mbw = ph // 16, pw // 16
-        wave_fn = _encode_wave_gop if self.inter else _encode_wave
-        out = wave_fn(ysd, usd, vsd, qp, mbw=mbw, mbh=mbh, mesh=self.mesh)
+        if self.inter and self.num_devices == 1:
+            out = _encode_gop_single(ysd, usd, vsd, qp, mbw=mbw, mbh=mbh)
+        else:
+            wave_fn = _encode_wave_gop if self.inter else _encode_wave
+            out = wave_fn(ysd, usd, vsd, qp, mbw=mbw, mbh=mbh,
+                          mesh=self.mesh)
+        for arr in out:
+            # Start the device->host copies now, overlapped with the next
+            # wave's compute (the transfer link has high latency — axon
+            # tunnels measure ~0.1-0.2 s per blocking fetch).
+            try:
+                arr.copy_to_host_async()
+            except Exception:       # noqa: BLE001 - best-effort prefetch
+                pass
         return (wave, ysd, usd, vsd, mbw, mbh, out)
 
     def collect_wave(self, pending: tuple) -> list[EncodedSegment]:
@@ -252,25 +307,35 @@ class GopShardEncoder:
         segments: list[EncodedSegment] = []
         F = ysd.shape[1]
         nmb = mbw * mbh
-        L = (nmb * _INTRA_MB + (F - 1) * nmb * _P_MB if self.inter
+        L = (nmb * _INTRA_MB + (F - 1) * nmb * _P_FLAT_MB if self.inter
              else nmb * _INTRA_MB)
-        nnz, n_esc, bitmap, vals, esc_pos, esc_val = jax.device_get(out)
-        sparse_ok = jaxcore.sparse_fits(nnz.max(), n_esc.max(), L)
+        if self.inter:
+            mv8, nnz, n_esc, bitmap, vals, esc_pos, esc_val = \
+                jax.device_get(out)
+            sparse_ok = jaxcore.block_sparse_fits(nnz.max(), n_esc.max(), L)
+        else:
+            nnz, n_esc, bitmap, vals, esc_pos, esc_val = jax.device_get(out)
+            sparse_ok = jaxcore.sparse_fits(nnz.max(), n_esc.max(), L)
         if not sparse_ok:
-            dense_fn = (_encode_wave_gop_dense if self.inter
-                        else _encode_wave_dense)
-            flat = jax.device_get(dense_fn(
-                ysd, usd, vsd, jnp.asarray(self.qp), mbw=mbw, mbh=mbh,
-                mesh=self.mesh, dtype=jnp.int16))
+            if self.inter and self.num_devices == 1:
+                flat = jax.device_get(_encode_gop_single_dense(
+                    ysd, usd, vsd, jnp.asarray(self.qp), mbw=mbw,
+                    mbh=mbh, dtype=jnp.int16))
+            else:
+                dense_fn = (_encode_wave_gop_dense if self.inter
+                            else _encode_wave_dense)
+                flat = jax.device_get(dense_fn(
+                    ysd, usd, vsd, jnp.asarray(self.qp), mbw=mbw,
+                    mbh=mbh, mesh=self.mesh, dtype=jnp.int16))
         for gi, gop in enumerate(wave):
             if self.inter:
                 if sparse_ok:
-                    raw = jaxcore._sparse_unpack(
+                    raw = jaxcore._block_sparse_unpack(
                         int(nnz[gi]), int(n_esc[gi]), bitmap[gi],
                         vals[gi], esc_pos[gi], esc_val[gi], L)
                 else:
                     raw = flat[gi]
-                payload = self._pack_gop(gop, raw, F, mbw, mbh)
+                payload = self._pack_gop(gop, mv8[gi], raw, F, mbw, mbh)
             else:
                 payload = []
                 for fi in range(gop.num_frames):
@@ -294,41 +359,61 @@ class GopShardEncoder:
                 frame_sizes=tuple(len(p) for p in payload)))
         return segments
 
-    def encode_waves(self, waves) -> list[EncodedSegment]:
-        """Dispatch staged waves: device compute → sparse fetch → host
-        entropy pack, in wave order.
+    #: in-flight wave window: staged inputs + outputs of this many waves
+    #: stay alive at once (device queue depth x transfer overlap).
+    PIPELINE_WINDOW = 4
 
-        Depth-2 pipelining: wave i+1 is staged and dispatched before
-        wave i's fetch, so its compute overlaps the fetch + pack without
-        pinning the whole clip in device memory.
+    def encode_waves(self, waves, window: int | None = None,
+                     pack_workers: int | None = None
+                     ) -> list[EncodedSegment]:
+        """Dispatch staged waves: device compute → async sparse fetch →
+        host entropy pack, in wave order.
+
+        Pipelined three ways: up to `window` waves are dispatched ahead
+        (device queue + async device→host copies overlap the current
+        fetch), and each wave's fetch+pack runs on a thread pool (the
+        ctypes CAVLC packer releases the GIL, GOPs are independent), so
+        host packing overlaps device compute of later waves.
         """
+        import concurrent.futures as cf
+        import os as _os
+
+        window = window or self.PIPELINE_WINDOW
+        workers = pack_workers or min(window, _os.cpu_count() or 2)
         segments: list[EncodedSegment] = []
         waves = iter(waves)
-        pending: list[tuple] = []
+        pending: list[cf.Future] = []
 
-        def dispatch_next():
-            try:
-                staged = next(waves)
-            except StopIteration:
-                return
-            pending.append(self.dispatch_wave(staged))
+        with cf.ThreadPoolExecutor(workers) as pool:
+            def dispatch_next():
+                try:
+                    staged = next(waves)
+                except StopIteration:
+                    return False
+                pending.append(
+                    pool.submit(self.collect_wave,
+                                self.dispatch_wave(staged)))
+                return True
 
-        dispatch_next()
-        while pending:
-            dispatch_next()                       # overlap: depth-2 window
-            segments.extend(self.collect_wave(pending.pop(0)))
+            for _ in range(window):
+                if not dispatch_next():
+                    break
+            while pending:
+                segs = pending.pop(0).result()
+                dispatch_next()
+                segments.extend(segs)
         return segments
 
-    def _pack_gop(self, gop: GopSpec, flat: np.ndarray, F: int, mbw: int,
-                  mbh: int) -> list[bytes]:
+    def _pack_gop(self, gop: GopSpec, mv8: np.ndarray, flat: np.ndarray,
+                  F: int, mbw: int, mbh: int) -> list[bytes]:
         """Entropy-pack one GOP (IDR + P slices) from its flat levels."""
-        from ..codecs.h264.encoder import pack_gop_slices
+        from ..codecs.h264.encoder import pack_gop_slices_planes
 
-        intra, pouts = _unflatten_gop(flat.astype(np.int32), F, mbw, mbh)
+        intra, planes = _unflatten_gop(flat, mv8, F, mbw, mbh)
         # gop.num_frames (not F) drops the wave's tail-repeat padding.
-        return pack_gop_slices(intra, pouts, gop.num_frames, mbw, mbh,
-                               self.sps, self.pps, self.qp,
-                               idr_pic_id=gop.index)
+        return pack_gop_slices_planes(intra, planes, gop.num_frames,
+                                      mbw, mbh, self.sps, self.pps,
+                                      self.qp, idr_pic_id=gop.index)
 
     @staticmethod
     def _gop_plane(padded: list[Frame], gop: GopSpec, F: int, plane: str
