@@ -1,0 +1,216 @@
+"""HTTP API tests: the full job lifecycle driven over a live socket.
+
+Mirrors the reference's route surface contracts
+(/root/reference/manager/app.py:1919-2400, 2836-3051).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from thinvids_tpu.api import ApiServer
+from thinvids_tpu.cluster.coordinator import Coordinator
+from thinvids_tpu.cluster.executor import LocalExecutor
+from thinvids_tpu.core.config import reset_live_settings, update_live_settings
+from thinvids_tpu.core.status import Status
+from thinvids_tpu.core.types import Frame, VideoMeta
+from thinvids_tpu.io.y4m import write_y4m
+
+
+def make_clip(path, n=6, w=48, h=32):
+    frames = [Frame(np.full((h, w), 50 + 10 * i, np.uint8),
+                    np.full((h // 2, w // 2), 110, np.uint8),
+                    np.full((h // 2, w // 2), 140, np.uint8))
+              for i in range(n)]
+    meta = VideoMeta(width=w, height=h, fps_num=30, fps_den=1, num_frames=n)
+    write_y4m(path, meta, frames)
+
+
+def call(url, method="GET", body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+@pytest.fixture
+def api(tmp_path):
+    reset_live_settings()
+    co = Coordinator()
+    # an always-available worker pool so dispatch gates pass
+    for i in range(6):
+        co.registry.heartbeat(f"w{i}")
+    update_live_settings({"pipeline_worker_count": 6,
+                          "min_idle_workers": 0})
+    execu = LocalExecutor(co, str(tmp_path / "out"), sync=False)
+    co._launcher = execu.launch
+    server = ApiServer(co).start()
+    yield server, co, execu, tmp_path
+    server.stop()
+    reset_live_settings()
+
+
+class TestLifecycle:
+    def test_full_job_lifecycle_over_http(self, api):
+        server, co, execu, tmp_path = api
+        clip = tmp_path / "movie.y4m"
+        make_clip(str(clip))
+
+        code, job = call(f"{server.url}/add_job", "POST",
+                         {"input_path": str(clip), "auto_start": False})
+        assert code == 201
+        jid = job["id"]
+        assert job["status"] == "ready"
+        assert job["meta"]["num_frames"] == 6
+
+        code, listing = call(f"{server.url}/jobs")
+        assert code == 200 and listing["total"] == 1
+
+        code, started = call(f"{server.url}/start_job/{jid}", "POST")
+        assert code == 200
+        execu.join(timeout=120)
+
+        code, props = call(f"{server.url}/job_properties/{jid}")
+        assert code == 200
+        assert props["job"]["status"] == "done"
+        assert props["job"]["output_path"].endswith("movie.mp4")
+        assert props["job"]["parts_done"] >= 1
+        assert any("done" in line for line in props["activity"])
+
+        code, feed = call(f"{server.url}/activity")
+        assert code == 200 and feed["events"]
+
+        code, _ = call(f"{server.url}/delete_job/{jid}", "DELETE")
+        assert code == 200
+        code, listing = call(f"{server.url}/jobs")
+        assert listing["total"] == 0
+
+    def test_stop_and_restart(self, api):
+        server, co, execu, tmp_path = api
+        clip = tmp_path / "movie.y4m"
+        make_clip(str(clip))
+        code, job = call(f"{server.url}/add_job", "POST",
+                         {"input_path": str(clip), "auto_start": False})
+        jid = job["id"]
+        code, stopped = call(f"{server.url}/stop_job/{jid}", "POST")
+        assert stopped["status"] == "stopped"
+        code, restarted = call(f"{server.url}/restart_job/{jid}", "POST")
+        assert restarted["status"] in ("waiting", "starting", "running",
+                                       "done")
+        execu.join(timeout=120)
+        code, props = call(f"{server.url}/job_properties/{jid}")
+        assert props["job"]["status"] == "done"
+
+
+class TestRoutes:
+    def test_add_job_validation(self, api):
+        server, *_ = api
+        code, err = call(f"{server.url}/add_job", "POST", {})
+        assert code == 400 and "input_path" in err["error"]
+        code, err = call(f"{server.url}/add_job", "POST",
+                         {"input_path": "/nonexistent.y4m"})
+        assert code == 422
+
+    def test_unknown_routes_and_jobs(self, api):
+        server, *_ = api
+        code, err = call(f"{server.url}/nope")
+        assert code == 404
+        code, err = call(f"{server.url}/job_properties/deadbeef")
+        assert code == 404
+
+    def test_jobs_filter_sort_paginate(self, api):
+        server, co, execu, tmp_path = api
+        for i in range(3):
+            clip = tmp_path / f"c{i}.y4m"
+            make_clip(str(clip), n=2)
+            call(f"{server.url}/add_job", "POST",
+                 {"input_path": str(clip), "auto_start": False})
+        code, out = call(
+            f"{server.url}/jobs?status=ready&sort=input_path&order=asc"
+            f"&page=1&page_size=2")
+        assert code == 200
+        assert out["total"] == 3 and len(out["jobs"]) == 2
+        names = [j["input_path"] for j in out["jobs"]]
+        assert names == sorted(names)
+        code, out = call(f"{server.url}/jobs?sort=bogus")
+        assert code == 400
+
+    def test_job_settings_blocked_while_active(self, api):
+        server, co, execu, tmp_path = api
+        clip = tmp_path / "movie.y4m"
+        make_clip(str(clip))
+        code, job = call(f"{server.url}/add_job", "POST",
+                         {"input_path": str(clip), "auto_start": False})
+        jid = job["id"]
+        code, out = call(f"{server.url}/job_settings/{jid}", "POST",
+                         {"qp": 33})
+        assert code == 200 and out["settings"] == {"qp": 33}
+        co.store.update(jid, lambda j: setattr(j, "status", Status.RUNNING))
+        code, err = call(f"{server.url}/job_settings/{jid}", "POST",
+                         {"qp": 20})
+        assert code == 409
+
+    def test_job_settings_validated_at_write(self, api):
+        server, co, execu, tmp_path = api
+        clip = tmp_path / "movie.y4m"
+        make_clip(str(clip))
+        code, job = call(f"{server.url}/add_job", "POST",
+                         {"input_path": str(clip), "auto_start": False})
+        jid = job["id"]
+        # malformed value -> clamped to the key's default at WRITE time
+        # (the config tier is deliberately lenient, mirroring the
+        # reference's POST /settings clamping) — what's stored is what
+        # dispatch will use, never the raw garbage
+        code, out = call(f"{server.url}/job_settings/{jid}", "POST",
+                         {"gop_frames": "abc"})
+        assert code == 200 and out["settings"] == {"gop_frames": 32}
+        # unknown key -> 400 (overlay would silently drop it otherwise)
+        code, err = call(f"{server.url}/job_settings/{jid}", "POST",
+                         {"no_such_knob": 1})
+        assert code == 400
+        # valid values are coerced/clamped exactly like the live tier
+        code, out = call(f"{server.url}/job_settings/{jid}", "POST",
+                         {"gop_frames": "16"})
+        assert code == 200 and out["settings"] == {"gop_frames": 16}
+
+    def test_nodes_and_metrics(self, api):
+        server, co, *_ = api
+        co.registry.heartbeat("w0", metrics={"hbm_used": 0.5})
+        code, out = call(f"{server.url}/nodes_data")
+        assert code == 200
+        hosts = {n["host"] for n in out["nodes"]}
+        assert "w0" in hosts and len(hosts) == 6
+        code, _ = call(f"{server.url}/nodes/disable/w0", "POST",
+                       {"reason": "flaky"})
+        code, out = call(f"{server.url}/nodes_data")
+        w0 = next(n for n in out["nodes"] if n["host"] == "w0")
+        assert w0["disabled"] and w0["quarantine_reason"] == "flaky"
+        call(f"{server.url}/nodes/enable/w0", "POST")
+        code, out = call(f"{server.url}/metrics_snapshot")
+        assert out["metrics"]["w0"]["hbm_used"] == 0.5
+        code, _ = call(f"{server.url}/nodes/delete/w5", "DELETE")
+        assert code == 200
+        code, _ = call(f"{server.url}/nodes/delete/w5", "DELETE")
+        assert code == 404
+
+    def test_settings_roundtrip_with_clamps(self, api):
+        server, *_ = api
+        code, out = call(f"{server.url}/settings")
+        assert code == 200 and "qp" in out["settings"]
+        code, out = call(f"{server.url}/settings", "POST", {"qp": 99})
+        assert code == 200
+        code, out = call(f"{server.url}/settings")
+        assert 0 <= out["settings"]["qp"] <= 51    # clamped
+
+    def test_health(self, api):
+        server, *_ = api
+        code, out = call(f"{server.url}/health")
+        assert code == 200 and out["ok"]

@@ -1,0 +1,199 @@
+"""Ingest layer tests: ledger durability, stabilization deferral,
+no-double-submit, bootstrap, probing, coordinator glue.
+
+Mirrors the reference watcher's operational contract
+(/root/reference/manager/watcher.py:73-266, 351-452, 482-503, 586-673).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from thinvids_tpu.core.types import Frame, VideoMeta
+from thinvids_tpu.ingest import (
+    FileLedger,
+    WatchIngester,
+    coordinator_submitter,
+    probe_video,
+)
+from thinvids_tpu.ingest.probe import ProbeError
+from thinvids_tpu.ingest.watcher import file_signature
+from thinvids_tpu.io.y4m import write_y4m
+
+
+def make_clip(path, n=4, w=32, h=16):
+    frames = [Frame(np.full((h, w), 60 + i, np.uint8),
+                    np.full((h // 2, w // 2), 110, np.uint8),
+                    np.full((h // 2, w // 2), 140, np.uint8))
+              for i in range(n)]
+    meta = VideoMeta(width=w, height=h, fps_num=30, fps_den=1,
+                     num_frames=n)
+    write_y4m(path, meta, frames)
+    return meta
+
+
+class TestFileLedger:
+    def test_roundtrip_and_states(self, tmp_path):
+        led = FileLedger(str(tmp_path / "processed.log"))
+        assert led.state("a.y4m", "1:2") == "missing"
+        led.mark("a.y4m", "1:2")
+        assert led.state("a.y4m", "1:2") == "matched"
+        assert led.state("a.y4m", "9:9") == "changed"
+
+        # a fresh instance reads the same state back from disk
+        led2 = FileLedger(str(tmp_path / "processed.log"))
+        assert led2.state("a.y4m", "1:2") == "matched"
+
+    def test_legacy_path_only_lines(self, tmp_path):
+        p = tmp_path / "processed.log"
+        p.write_text("old/movie.mkv\n")
+        led = FileLedger(str(p))
+        assert led.state("old/movie.mkv", "5:5") == "legacy"
+        led.mark("old/movie.mkv", "5:5")
+        assert led.state("old/movie.mkv", "5:5") == "matched"
+
+    def test_external_rewrite_reload(self, tmp_path):
+        p = tmp_path / "processed.log"
+        led = FileLedger(str(p))
+        led.mark("a.y4m", "1:1")
+        # another process rewrites the ledger (e.g. manual submission
+        # marked by the manager, reference app.py:843-870)
+        os_mtime_bump = json.dumps({"path": "b.y4m", "sig": "2:2"})
+        p.write_text(os_mtime_bump + "\n")
+        os.utime(p, ns=(0, 10**15))
+        assert led.reload_if_changed()
+        assert led.state("b.y4m", "2:2") == "matched"
+        assert led.state("a.y4m", "1:1") == "missing"
+
+    def test_appends_are_json_lines(self, tmp_path):
+        p = tmp_path / "processed.log"
+        led = FileLedger(str(p))
+        led.mark("x.y4m", "3:4")
+        rec = json.loads(p.read_text().strip())
+        assert rec == {"path": "x.y4m", "sig": "3:4"}
+
+
+class TestWatchIngester:
+    def make(self, tmp_path, stable_checks=2, submit=None):
+        watch = tmp_path / "watch"
+        watch.mkdir(exist_ok=True)
+        led = FileLedger(str(tmp_path / "processed.log"))
+        calls = []
+
+        def recording_submit(path):
+            calls.append(path)
+            return True
+
+        ing = WatchIngester(str(watch), led, submit or recording_submit,
+                            stable_checks=stable_checks)
+        return watch, led, ing, calls
+
+    def test_unstable_file_deferred_then_submitted(self, tmp_path):
+        watch, led, ing, calls = self.make(tmp_path, stable_checks=2)
+        clip = watch / "a.y4m"
+        make_clip(str(clip), n=2)
+        assert ing.scan_once() == []          # first sighting: streak 1
+        # file grows between scans (still being copied in)
+        make_clip(str(clip), n=4)
+        os.utime(clip, ns=(10**15, 10**15))
+        assert ing.scan_once() == []          # signature changed: reset
+        assert ing.scan_once() == ["a.y4m"]   # stable for 2 scans
+        assert calls == [str(clip)]
+
+    def test_no_double_submit(self, tmp_path):
+        watch, led, ing, calls = self.make(tmp_path, stable_checks=1)
+        make_clip(str(watch / "a.y4m"))
+        assert ing.scan_once() == ["a.y4m"]
+        assert ing.scan_once() == []          # ledger: matched
+        assert len(calls) == 1
+        # a brand-new ingester (restart) must not resubmit either
+        _, _, ing2, calls2 = self.make(tmp_path, stable_checks=1)
+        assert ing2.scan_once() == []
+        assert calls2 == []
+
+    def test_changed_file_resubmitted(self, tmp_path):
+        watch, led, ing, calls = self.make(tmp_path, stable_checks=1)
+        clip = watch / "a.y4m"
+        make_clip(str(clip), n=2)
+        assert ing.scan_once() == ["a.y4m"]
+        make_clip(str(clip), n=6)             # replaced with a new cut
+        os.utime(clip, ns=(2 * 10**15, 2 * 10**15))
+        assert ing.scan_once() == ["a.y4m"]
+        assert len(calls) == 2
+
+    def test_bootstrap_adopts_without_submitting(self, tmp_path):
+        watch, led, ing, calls = self.make(tmp_path, stable_checks=1)
+        make_clip(str(watch / "old1.y4m"))
+        make_clip(str(watch / "old2.y4m"))
+        assert ing.bootstrap_if_first_run() == 2
+        assert ing.scan_once() == []
+        assert calls == []
+        # bootstrap is first-run only
+        make_clip(str(watch / "new.y4m"))
+        assert ing.bootstrap_if_first_run() == 0
+        assert ing.scan_once() == ["new.y4m"]
+
+    def test_failed_submit_not_marked(self, tmp_path):
+        def refuse(path):
+            return False
+
+        watch, led, ing, calls = self.make(tmp_path, stable_checks=1,
+                                           submit=refuse)
+        make_clip(str(watch / "a.y4m"))
+        assert ing.scan_once() == []
+        assert led.state("a.y4m",
+                         file_signature(str(watch / "a.y4m"))) == "missing"
+
+    def test_non_media_ignored(self, tmp_path):
+        watch, led, ing, calls = self.make(tmp_path, stable_checks=1)
+        (watch / "notes.txt").write_text("hi")
+        (watch / ".hidden.y4m").write_bytes(b"junk")
+        assert ing.scan_once() == []
+
+
+class TestProbe:
+    def test_y4m_probe(self, tmp_path):
+        p = tmp_path / "clip.y4m"
+        make_clip(str(p), n=7, w=64, h=32)
+        meta = probe_video(str(p))
+        assert (meta.width, meta.height) == (64, 32)
+        assert meta.num_frames == 7
+        assert meta.codec == "rawvideo"
+        assert meta.size_bytes == os.path.getsize(p)
+
+    def test_unknown_extension(self, tmp_path):
+        p = tmp_path / "clip.xyz"
+        p.write_bytes(b"data")
+        with pytest.raises(ProbeError):
+            probe_video(str(p))
+
+    def test_corrupt_y4m(self, tmp_path):
+        p = tmp_path / "clip.y4m"
+        p.write_bytes(b"NOT A Y4M FILE\n")
+        with pytest.raises(ProbeError):
+            probe_video(str(p))
+
+
+class TestCoordinatorGlue:
+    def test_watch_to_job(self, tmp_path):
+        from thinvids_tpu.cluster.coordinator import Coordinator
+        from thinvids_tpu.core.status import Status
+
+        co = Coordinator()
+        watch = tmp_path / "watch"
+        watch.mkdir()
+        led = FileLedger(str(tmp_path / "processed.log"))
+        ing = WatchIngester(str(watch), led, coordinator_submitter(co),
+                            stable_checks=1)
+        clip = watch / "movie.y4m"
+        make_clip(str(clip), n=3)
+        assert ing.scan_once() == ["movie.y4m"]
+        jobs = co.store.list()
+        assert len(jobs) == 1
+        assert jobs[0].input_path == str(clip)
+        assert jobs[0].meta.num_frames == 3
+        # no resubmission on the next pass
+        assert ing.scan_once() == []
+        assert len(co.store.list()) == 1

@@ -1,0 +1,10 @@
+"""HTTP API: the JSON control surface over the coordinator.
+
+Mirrors the reference manager's Flask route surface
+(/root/reference/manager/app.py:1919-2400) on the stdlib http.server —
+no framework dependency, same contracts.
+"""
+
+from .server import ApiServer
+
+__all__ = ["ApiServer"]

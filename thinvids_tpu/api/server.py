@@ -1,0 +1,351 @@
+"""Stdlib HTTP JSON API over the coordinator.
+
+Route surface ported from the reference manager
+(/root/reference/manager/app.py):
+
+    GET  /health                        liveness probe
+    GET  /jobs                          list + filter/sort/paginate (:1919-2096)
+    POST /add_job                       probe + register (+auto queue) (:2222-2400)
+    POST /start_job/<id>                queue + dispatch (:2402-2460)
+    POST /stop_job/<id>                 stop + fence (:2673-2700)
+    POST /restart_job/<id>              wipe + requeue (:2501-2666)
+    DELETE /delete_job/<id>             remove (:2702-2718)
+    GET  /job_properties/<id>           job fields + activity tail (:2720-2744)
+    GET/POST /job_settings/<id>         per-job overrides, blocked while
+                                        RUNNING (:2746-2812)
+    GET  /activity                      global activity feed (:2098-2108)
+    GET  /job_activity/<id>             per-job log lines (:2110-2117)
+    GET  /nodes_data                    worker registry view (:2836-2885)
+    POST /nodes/disable/<host>          quarantine (:2856-2885)
+    POST /nodes/enable/<host>
+    DELETE /nodes/delete/<host>
+    GET  /metrics_snapshot              per-worker metrics (:1701-1748)
+    GET/POST /settings                  live cluster settings with
+                                        validation/clamping (:1750-1916)
+
+Bodies and responses are JSON. Unknown paths → 404 {"error": ...};
+handler exceptions → 400/500 with the message.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+from urllib.parse import parse_qs, urlparse
+
+from ..core.config import update_live_settings
+from ..core.status import Status
+from ..cluster.coordinator import Coordinator
+from ..cluster.jobs import Job
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+def _job_view(job: Job) -> dict[str, Any]:
+    return job.to_dict()
+
+
+# Scalar, orderable Job fields (sorting by meta/settings or mixing types
+# would TypeError inside list.sort); `status` sorts by its string value.
+# Annotations are strings under `from __future__ import annotations`, so
+# match the annotation text.
+_SORTABLE = {f.name for f in dataclasses.fields(Job)
+             if str(f.type) in ("str", "int", "float")} | {"status"}
+
+
+class ApiServer:
+    """Threaded HTTP server bound to a Coordinator instance."""
+
+    def __init__(self, coordinator: Coordinator, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        self.coordinator = coordinator
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # quiet request logging (the reference silenced werkzeug,
+            # /root/reference/common.py:151-161)
+            def log_message(self, *args: Any) -> None:
+                pass
+
+            def _reply(self, status: int, payload: Any) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> dict[str, Any]:
+                length = int(self.headers.get("Content-Length") or 0)
+                if not length:
+                    return {}
+                raw = self.rfile.read(length)
+                try:
+                    data = json.loads(raw)
+                except json.JSONDecodeError as exc:
+                    raise ApiError(400, f"invalid JSON body: {exc}")
+                if not isinstance(data, dict):
+                    raise ApiError(400, "JSON body must be an object")
+                return data
+
+            def _dispatch(self, method: str) -> None:
+                url = urlparse(self.path)
+                query = {k: v[-1] for k, v in parse_qs(url.query).items()}
+                try:
+                    body = self._body() if method in ("POST", "PUT") else {}
+                    status, payload = api.route(method, url.path, query,
+                                                body)
+                    self._reply(status, payload)
+                except ApiError as exc:
+                    self._reply(exc.status, {"error": exc.message})
+                except (KeyError, ValueError) as exc:
+                    self._reply(400, {"error": str(exc)})
+                except Exception as exc:    # noqa: BLE001 - surface, don't die
+                    self._reply(500, {"error": f"{type(exc).__name__}: {exc}"})
+
+            def do_GET(self) -> None:
+                self._dispatch("GET")
+
+            def do_POST(self) -> None:
+                self._dispatch("POST")
+
+            def do_PUT(self) -> None:
+                self._dispatch("PUT")
+
+            def do_DELETE(self) -> None:
+                self._dispatch("DELETE")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ApiServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="tvt-api")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(5)
+
+    # -- routing -------------------------------------------------------
+
+    _ROUTES = [
+        ("GET", r"^/health$", "health"),
+        ("GET", r"^/jobs$", "jobs"),
+        ("POST", r"^/add_job$", "add_job"),
+        ("POST", r"^/start_job/(?P<job_id>[\w-]+)$", "start_job"),
+        ("POST", r"^/stop_job/(?P<job_id>[\w-]+)$", "stop_job"),
+        ("POST", r"^/restart_job/(?P<job_id>[\w-]+)$", "restart_job"),
+        ("DELETE", r"^/delete_job/(?P<job_id>[\w-]+)$", "delete_job"),
+        ("GET", r"^/job_properties/(?P<job_id>[\w-]+)$", "job_properties"),
+        ("GET", r"^/job_settings/(?P<job_id>[\w-]+)$", "get_job_settings"),
+        ("POST", r"^/job_settings/(?P<job_id>[\w-]+)$", "post_job_settings"),
+        ("GET", r"^/activity$", "activity"),
+        ("GET", r"^/job_activity/(?P<job_id>[\w-]+)$", "job_activity"),
+        ("GET", r"^/nodes_data$", "nodes_data"),
+        ("POST", r"^/nodes/disable/(?P<host>[\w.-]+)$", "node_disable"),
+        ("POST", r"^/nodes/enable/(?P<host>[\w.-]+)$", "node_enable"),
+        ("DELETE", r"^/nodes/delete/(?P<host>[\w.-]+)$", "node_delete"),
+        ("GET", r"^/metrics_snapshot$", "metrics_snapshot"),
+        ("GET", r"^/settings$", "get_settings"),
+        ("POST", r"^/settings$", "post_settings"),
+    ]
+
+    def route(self, method: str, path: str, query: dict[str, str],
+              body: dict[str, Any]) -> tuple[int, Any]:
+        for meth, pattern, name in self._ROUTES:
+            if meth != method:
+                continue
+            m = re.match(pattern, path)
+            if m:
+                handler = getattr(self, f"_h_{name}")
+                return handler(query=query, body=body, **m.groupdict())
+        raise ApiError(404, f"no route {method} {path}")
+
+    def _get_job(self, job_id: str) -> Job:
+        job = self.coordinator.store.try_get(job_id)
+        if job is None:
+            raise ApiError(404, f"no job {job_id}")
+        return job
+
+    # -- handlers ------------------------------------------------------
+
+    def _h_health(self, query, body) -> tuple[int, Any]:
+        return 200, {"ok": True, "jobs": len(self.coordinator.store)}
+
+    def _h_jobs(self, query, body) -> tuple[int, Any]:
+        """Filter/sort/paginate (reference GET /jobs,
+        /root/reference/manager/app.py:1919-2096)."""
+        jobs = self.coordinator.store.list()
+        status = query.get("status")
+        if status:
+            want = Status.parse(status)
+            jobs = [j for j in jobs if j.status is want]
+        search = query.get("search", "").lower()
+        if search:
+            jobs = [j for j in jobs if search in j.input_path.lower()]
+        sort = query.get("sort", "created_at")
+        reverse = query.get("order", "desc") != "asc"
+        if sort not in _SORTABLE:
+            raise ApiError(400, f"unknown sort key {sort!r}")
+        if sort == "status":
+            key = lambda j: j.status.value               # noqa: E731
+        else:
+            key = lambda j: getattr(j, sort)             # noqa: E731
+        jobs.sort(key=key, reverse=reverse)
+        page = max(1, int(query.get("page", 1)))
+        page_size = min(500, max(1, int(query.get("page_size", 50))))
+        start = (page - 1) * page_size
+        window = jobs[start:start + page_size]
+        return 200, {
+            "jobs": [_job_view(j) for j in window],
+            "total": len(jobs),
+            "page": page,
+            "page_size": page_size,
+        }
+
+    def _h_add_job(self, query, body) -> tuple[int, Any]:
+        input_path = body.get("input_path")
+        if not input_path:
+            raise ApiError(400, "input_path is required")
+        from ..ingest.probe import ProbeError, probe_video
+
+        try:
+            meta = probe_video(input_path)
+        except ProbeError as exc:
+            raise ApiError(422, str(exc))
+        job = self.coordinator.add_job(
+            input_path, meta, settings=body.get("settings"),
+            auto_start=body.get("auto_start"))
+        return 201, _job_view(job)
+
+    def _h_start_job(self, query, body, job_id) -> tuple[int, Any]:
+        self._get_job(job_id)
+        job = self.coordinator.queue_job(job_id)
+        self.coordinator.dispatch_next_waiting_job()
+        return 200, _job_view(self.coordinator.store.get(job.id))
+
+    def _h_stop_job(self, query, body, job_id) -> tuple[int, Any]:
+        self._get_job(job_id)
+        return 200, _job_view(self.coordinator.stop_job(job_id))
+
+    def _h_restart_job(self, query, body, job_id) -> tuple[int, Any]:
+        self._get_job(job_id)
+        return 200, _job_view(self.coordinator.restart_job(job_id))
+
+    def _h_delete_job(self, query, body, job_id) -> tuple[int, Any]:
+        self._get_job(job_id)
+        self.coordinator.delete_job(job_id)
+        return 200, {"deleted": job_id}
+
+    def _h_job_properties(self, query, body, job_id) -> tuple[int, Any]:
+        job = self._get_job(job_id)
+        lines = self.coordinator.activity.fetch_job(
+            job_id, limit=int(query.get("limit", 100)))
+        return 200, {"job": _job_view(job), "activity": lines}
+
+    def _h_get_job_settings(self, query, body, job_id) -> tuple[int, Any]:
+        job = self._get_job(job_id)
+        return 200, {"settings": dict(job.settings)}
+
+    def _h_post_job_settings(self, query, body, job_id) -> tuple[int, Any]:
+        job = self._get_job(job_id)
+        if job.status.is_active:
+            # reference blocks edits while RUNNING (app.py:2746-2812)
+            raise ApiError(409, f"job is {job.status.value}; stop it first")
+
+        # Validate at write time, exactly as the live-settings tier does
+        # (config._validate_setting is shared by both) — a bad value
+        # must 400 here, not explode later at dispatch inside
+        # overlay_job_settings.
+        from ..core import config as config_mod
+
+        validated: dict[str, Any] = {}
+        for key, raw in body.items():
+            if key not in config_mod.JOB_SETTING_KEYS:
+                raise ApiError(400, f"unknown job setting {key!r}")
+            try:
+                validated[key] = config_mod._validate_setting(key, raw)
+            except (TypeError, ValueError) as exc:
+                raise ApiError(400, f"bad value for {key!r}: {exc}")
+
+        def apply(j: Job) -> None:
+            j.settings = validated
+        job = self.coordinator.store.update(job_id, apply)
+        return 200, {"settings": dict(job.settings)}
+
+    def _h_activity(self, query, body) -> tuple[int, Any]:
+        limit = int(query.get("limit", 100))
+        return 200, {"events": self.coordinator.activity.fetch(limit)}
+
+    def _h_job_activity(self, query, body, job_id) -> tuple[int, Any]:
+        limit = int(query.get("limit", 500))
+        return 200, {"lines": self.coordinator.activity.fetch_job(
+            job_id, limit)}
+
+    def _h_nodes_data(self, query, body) -> tuple[int, Any]:
+        snap = self.coordinator._settings_fn()
+        ttl = float(snap.metrics_ttl_s)
+        active = {w.host for w in self.coordinator.registry.active(ttl)}
+        nodes = []
+        for w in self.coordinator.registry.all():
+            nodes.append({
+                "host": w.host,
+                "role": w.role,
+                "last_seen": w.last_seen,
+                "active": w.host in active,
+                "disabled": w.disabled,
+                "quarantine_reason": w.quarantine_reason,
+            })
+        nodes.sort(key=lambda n: n["host"])
+        return 200, {"nodes": nodes}
+
+    def _h_node_disable(self, query, body, host) -> tuple[int, Any]:
+        self.coordinator.registry.set_disabled(
+            host, True, reason=body.get("reason", "operator"))
+        return 200, {"host": host, "disabled": True}
+
+    def _h_node_enable(self, query, body, host) -> tuple[int, Any]:
+        self.coordinator.registry.set_disabled(host, False)
+        return 200, {"host": host, "disabled": False}
+
+    def _h_node_delete(self, query, body, host) -> tuple[int, Any]:
+        if not self.coordinator.registry.delete(host):
+            raise ApiError(404, f"no node {host}")
+        return 200, {"deleted": host}
+
+    def _h_metrics_snapshot(self, query, body) -> tuple[int, Any]:
+        metrics = {w.host: dict(w.metrics, last_seen=w.last_seen)
+                   for w in self.coordinator.registry.all()}
+        return 200, {"metrics": metrics}
+
+    def _h_get_settings(self, query, body) -> tuple[int, Any]:
+        snap = self.coordinator._settings_fn()
+        return 200, {"settings": dict(snap.values)}
+
+    def _h_post_settings(self, query, body) -> tuple[int, Any]:
+        applied = update_live_settings(body)
+        return 200, {"applied": applied}

@@ -72,10 +72,13 @@ class Job:
         return self.parts_done / self.parts_total
 
     def to_dict(self) -> dict[str, Any]:
+        """JSON-clean view (enums → names) for the API/store layers."""
         d = dataclasses.asdict(self)
         d["status"] = self.status.value
         if self.meta is not None:
-            d["meta"] = dataclasses.asdict(self.meta)
+            meta = dataclasses.asdict(self.meta)
+            meta["chroma"] = self.meta.chroma.name
+            d["meta"] = meta
         return d
 
 

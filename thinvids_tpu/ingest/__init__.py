@@ -1,0 +1,13 @@
+"""Ingest layer: watch-folder discovery, processed ledger, probing.
+
+Port of the reference's watcher daemon semantics
+(/root/reference/manager/watcher.py) onto the coordinator: files that
+appear under a watch root are size-stabilized, checked against a
+durable processed ledger, probed, and submitted as jobs.
+"""
+
+from .probe import probe_video
+from .watcher import FileLedger, WatchIngester, coordinator_submitter
+
+__all__ = ["probe_video", "FileLedger", "WatchIngester",
+           "coordinator_submitter"]

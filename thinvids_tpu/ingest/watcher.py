@@ -1,0 +1,263 @@
+"""Watch-folder ingest: processed ledger, size stabilization, discovery.
+
+Port of the reference watcher's semantics
+(/root/reference/manager/watcher.py):
+
+- `FileLedger` ≙ FileProcessedStore (watcher.py:73-266): a durable
+  rel_path → "size:mtime_ns" map as JSON lines, flock-serialized
+  appends + fsync, mtime-triggered external-change reload, legacy
+  path-only lines adopted lazily.
+- `WatchIngester` ≙ periodic_scanner + submit_job_if_stable
+  (watcher.py:351-452, 586-673): a file is submitted only after its
+  signature has been identical for `stable_checks` consecutive scans
+  (the reference polled size 5x at 10 s; here stability is measured in
+  scan ticks, which makes tests deterministic),
+  deduped through the ledger (marked synchronously on accept).
+- `bootstrap_if_first_run` ≙ bootstrap_processed_if_first_run
+  (watcher.py:482-503): an empty ledger adopts every existing file
+  without submitting, so a fresh deployment doesn't re-transcode the
+  whole library.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable, Iterable
+
+try:
+    import fcntl
+except ImportError:                      # non-POSIX: best-effort locking
+    fcntl = None
+
+
+def file_signature(path: str) -> str:
+    st = os.stat(path)
+    return f"{st.st_size}:{st.st_mtime_ns}"
+
+
+class FileLedger:
+    """Durable processed-file ledger (rel_path → signature)."""
+
+    LEGACY_SIG = ""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._entries: dict[str, str] = {}
+        self._loaded_mtime_ns: int | None = None
+        self._lock = threading.Lock()
+        self.reload_if_changed()
+
+    # -- reading -------------------------------------------------------
+
+    def _load(self) -> None:
+        entries: dict[str, str] = {}
+        try:
+            with open(self.path, "r", encoding="utf-8") as fp:
+                for line in fp:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        # legacy path-only line (reference
+                        # watcher.py:155-170): known, signature unknown
+                        entries[line] = self.LEGACY_SIG
+                        continue
+                    if isinstance(rec, dict) and "path" in rec:
+                        entries[str(rec["path"])] = str(rec.get("sig", ""))
+                    else:
+                        entries[line] = self.LEGACY_SIG
+            self._loaded_mtime_ns = os.stat(self.path).st_mtime_ns
+        except FileNotFoundError:
+            self._loaded_mtime_ns = None
+        self._entries = entries
+
+    def reload_if_changed(self) -> bool:
+        """Re-read the ledger if another writer changed it on disk."""
+        with self._lock:
+            try:
+                mtime = os.stat(self.path).st_mtime_ns
+            except FileNotFoundError:
+                mtime = None
+            if mtime != self._loaded_mtime_ns:
+                self._load()
+                return True
+            return False
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def entries(self) -> dict[str, str]:
+        return dict(self._entries)
+
+    def state(self, rel_path: str, sig: str) -> str:
+        """'missing' | 'legacy' | 'matched' | 'changed' for a file."""
+        have = self._entries.get(rel_path)
+        if have is None:
+            return "missing"
+        if have == self.LEGACY_SIG:
+            return "legacy"
+        return "matched" if have == sig else "changed"
+
+    # -- writing -------------------------------------------------------
+
+    def mark(self, rel_path: str, sig: str) -> None:
+        self.mark_many([(rel_path, sig)])
+
+    def mark_many(self, items) -> None:
+        """Append {path, sig} lines under ONE flock + fsync (reference
+        watcher.py:113-124; manager/app.py:859-870 uses the same
+        protocol for manual submissions). Batching matters for
+        bootstrap over a large library — one fsync, not one per file."""
+        items = list(items)
+        if not items:
+            return
+        payload = "".join(
+            json.dumps({"path": rel, "sig": sig},
+                       separators=(",", ":")) + "\n"
+            for rel, sig in items)
+        with self._lock:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            with open(self.path, "a", encoding="utf-8") as fp:
+                if fcntl is not None:
+                    fcntl.flock(fp.fileno(), fcntl.LOCK_EX)
+                try:
+                    fp.write(payload)
+                    fp.flush()
+                    os.fsync(fp.fileno())
+                finally:
+                    if fcntl is not None:
+                        fcntl.flock(fp.fileno(), fcntl.LOCK_UN)
+            for rel, sig in items:
+                self._entries[rel] = sig
+            try:
+                self._loaded_mtime_ns = os.stat(self.path).st_mtime_ns
+            except FileNotFoundError:
+                pass
+
+
+class WatchIngester:
+    """Scans a watch root and submits stabilized, unprocessed files.
+
+    `submit(abs_path) -> bool` is the injection point — in production
+    :func:`coordinator_submitter`; in tests a recording stub. A True
+    return marks the file processed in the ledger.
+    """
+
+    # Only extensions probe_video can actually ingest: submitting a
+    # file the probe rejects would never mark the ledger and retry
+    # forever. Widen in lockstep with ingest/probe.py.
+    DEFAULT_EXTS = (".y4m",)
+
+    def __init__(self, watch_dir: str, ledger: FileLedger,
+                 submit: Callable[[str], bool],
+                 exts: Iterable[str] = DEFAULT_EXTS,
+                 stable_checks: int = 3) -> None:
+        self.watch_dir = os.path.abspath(watch_dir)
+        self.ledger = ledger
+        self.submit = submit
+        self.exts = tuple(e.lower() for e in exts)
+        self.stable_checks = max(1, int(stable_checks))
+        #: rel_path → (last signature, consecutive identical scans)
+        self._stability: dict[str, tuple[str, int]] = {}
+
+    # -- discovery -----------------------------------------------------
+
+    def _discover(self) -> dict[str, str]:
+        """rel_path → signature for every candidate file on disk."""
+        found: dict[str, str] = {}
+        for root, _dirs, files in os.walk(self.watch_dir):
+            for name in files:
+                if not name.lower().endswith(self.exts):
+                    continue
+                if name.startswith("."):
+                    continue
+                abs_path = os.path.join(root, name)
+                try:
+                    sig = file_signature(abs_path)
+                except OSError:
+                    continue                     # vanished mid-scan
+                found[os.path.relpath(abs_path, self.watch_dir)] = sig
+        return found
+
+    def bootstrap_if_first_run(self) -> int:
+        """Empty ledger → adopt every existing file without submitting
+        (reference watcher.py:482-503). Returns files adopted."""
+        self.ledger.reload_if_changed()
+        if len(self.ledger):
+            return 0
+        found = self._discover()
+        self.ledger.mark_many(sorted(found.items()))
+        return len(found)
+
+    # -- scanning ------------------------------------------------------
+
+    def scan_once(self) -> list[str]:
+        """One discovery pass. Returns the rel paths submitted."""
+        self.ledger.reload_if_changed()
+        found = self._discover()
+        submitted: list[str] = []
+
+        # drop stability state for files that disappeared
+        for rel in list(self._stability):
+            if rel not in found:
+                del self._stability[rel]
+
+        for rel, sig in sorted(found.items()):
+            state = self.ledger.state(rel, sig)
+            if state == "matched":
+                continue
+            if state == "legacy":
+                # adopt the current signature without re-transcoding
+                # (lazy legacy adoption, reference watcher.py:155-170)
+                self.ledger.mark(rel, sig)
+                continue
+
+            prev_sig, streak = self._stability.get(rel, (None, 0))
+            streak = streak + 1 if sig == prev_sig else 1
+            self._stability[rel] = (sig, streak)
+            if streak < self.stable_checks:
+                continue                         # still stabilizing
+
+            abs_path = os.path.join(self.watch_dir, rel)
+            try:
+                accepted = self.submit(abs_path)
+            except Exception:                    # noqa: BLE001 - keep scanning
+                accepted = False
+            if accepted:
+                # Mark the signature that was OBSERVED stable: if the
+                # file changed while the submit ran, the next scan sees
+                # 'changed' and requeues the final content.
+                self.ledger.mark(rel, sig)
+                del self._stability[rel]
+                submitted.append(rel)
+        return submitted
+
+    def run(self, interval_s: float = 60.0,
+            stop: threading.Event | None = None) -> None:
+        """Blocking scan loop (the reference scanned every 60 s,
+        watcher.py:586-614)."""
+        stop = stop or threading.Event()
+        while not stop.is_set():
+            self.scan_once()
+            stop.wait(interval_s)
+
+
+def coordinator_submitter(coordinator, activity_host: str = "watcher"):
+    """submit() implementation targeting an in-process Coordinator:
+    probe → add_job (the reference POSTed to /add_job,
+    watcher.py:415-428). Unprobeable files are skipped (False)."""
+    from .probe import ProbeError, probe_video
+
+    def submit(abs_path: str) -> bool:
+        try:
+            meta = probe_video(abs_path)
+        except ProbeError:
+            return False
+        job = coordinator.add_job(abs_path, meta)
+        return job is not None
+
+    return submit
