@@ -1,0 +1,173 @@
+"""Durable state: journal-backed JobStore + file-backed ActivityLog.
+
+The reference survives manager restarts because Redis + the filesystem
+are the source of truth (SURVEY.md §5.4); these tests assert the same
+contract for the journal: a new coordinator over the same state dir
+sees every job, requeues orphaned in-flight work, and keeps activity
+history.
+"""
+
+import json
+import os
+
+from thinvids_tpu.cluster.coordinator import Coordinator
+from thinvids_tpu.cluster.jobs import Job, JobStore
+from thinvids_tpu.core.events import ActivityLog
+from thinvids_tpu.core.status import Status
+from thinvids_tpu.core.types import ChromaFormat, VideoMeta
+
+
+def _meta():
+    return VideoMeta(width=64, height=48, num_frames=10, codec="rawvideo",
+                     duration_s=0.33, size_bytes=999)
+
+
+class TestJobJson:
+    def test_roundtrip(self):
+        job = Job(id="j1", input_path="/x.y4m", meta=_meta(),
+                  status=Status.RUNNING, settings={"qp": 30},
+                  parts_total=4, parts_done=2, failure_reason="")
+        back = Job.from_dict(json.loads(json.dumps(job.to_dict())))
+        assert back == job
+        assert back.meta.chroma is ChromaFormat.YUV420
+
+    def test_unknown_fields_dropped(self):
+        d = Job(id="j2", input_path="/y.y4m").to_dict()
+        d["some_future_field"] = 1
+        d["meta"] = None
+        assert Job.from_dict(d).id == "j2"
+
+    def test_corrupt_status_becomes_failed_not_schedulable(self):
+        d = Job(id="j3", input_path="/z.y4m", status=Status.DONE).to_dict()
+        d["status"] = "garbage"
+        back = Job.from_dict(d)
+        assert back.status is Status.FAILED
+        assert "corrupt" in back.failure_reason
+
+
+class TestJobStoreJournal:
+    def test_restart_recovers_jobs(self, tmp_path):
+        path = str(tmp_path / "jobs.jsonl")
+        store = JobStore(path)
+        a = store.create("/a.y4m", meta=_meta())
+        store.create("/b.y4m")
+        store.update(a.id, lambda j: setattr(j, "status", Status.DONE))
+        store.close()
+
+        store2 = JobStore(path)
+        assert len(store2) == 2
+        assert store2.get(a.id).status is Status.DONE
+        assert store2.get(a.id).meta == _meta()
+
+    def test_delete_survives_restart(self, tmp_path):
+        path = str(tmp_path / "jobs.jsonl")
+        store = JobStore(path)
+        a = store.create("/a.y4m")
+        b = store.create("/b.y4m")
+        store.delete(a.id)
+        store.close()
+        store2 = JobStore(path)
+        assert store2.try_get(a.id) is None
+        assert store2.get(b.id).input_path == "/b.y4m"
+
+    def test_torn_tail_line_ignored(self, tmp_path):
+        path = str(tmp_path / "jobs.jsonl")
+        store = JobStore(path)
+        store.create("/a.y4m")
+        store.close()
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write('{"op": "put", "job": {"id": "tr')   # crash mid-write
+        store2 = JobStore(path)
+        assert len(store2) == 1
+
+    def test_compaction_bounds_journal(self, tmp_path):
+        path = str(tmp_path / "jobs.jsonl")
+        store = JobStore(path)
+        job = store.create("/a.y4m")
+        for i in range(1200):
+            store.update(job.id, lambda j: setattr(j, "parts_done", i))
+        with open(path, encoding="utf-8") as fh:
+            assert sum(1 for _ in fh) < 1200
+        store.close()
+        assert JobStore(path).get(job.id).parts_done == 1199
+
+    def test_second_store_on_same_journal_rejected(self, tmp_path):
+        path = str(tmp_path / "jobs.jsonl")
+        store = JobStore(path)
+        store.create("/a.y4m")
+        import pytest as _pytest
+        with _pytest.raises(RuntimeError, match="owned"):
+            JobStore(path)
+        store.close()
+        JobStore(path).close()     # released -> ok
+
+
+class TestActivityPersistence:
+    def test_events_survive_restart(self, tmp_path):
+        path = str(tmp_path / "activity.jsonl")
+        log = ActivityLog(path=path)
+        log.emit("start", "hello", job_id="j1")
+        log.emit("encode", "part done", job_id="j1", part=3)
+        log.close()
+        log2 = ActivityLog(path=path)
+        events = log2.fetch()
+        assert [e["message"] for e in events] == ["part done", "hello"]
+        assert log2.fetch_job("j1")
+        # appends keep working after replay
+        log2.emit("finish", "done", job_id="j1")
+        log2.close()
+        log3 = ActivityLog(path=path)
+        assert log3.fetch()[0]["message"] == "done"
+        log3.close()
+
+    def test_cap_truncates_file(self, tmp_path):
+        path = str(tmp_path / "activity.jsonl")
+        log = ActivityLog(cap=10, path=path)
+        for i in range(50):
+            log.emit("info", f"e{i}")
+        log.close()
+        log2 = ActivityLog(cap=10, path=path)
+        assert len(log2.fetch(100)) == 10
+        with open(path, encoding="utf-8") as fh:
+            assert sum(1 for _ in fh) == 10
+
+    def test_runtime_rotation_bounds_file(self, tmp_path):
+        path = str(tmp_path / "activity.jsonl")
+        log = ActivityLog(cap=10, path=path)
+        for i in range(200):                 # >> 4x cap
+            log.emit("info", f"e{i}")
+        with open(path, encoding="utf-8") as fh:
+            assert sum(1 for _ in fh) < 40
+        assert log.fetch(5)[0]["message"] == "e199"
+
+
+class TestCoordinatorRecovery:
+    def test_orphaned_running_job_requeued(self, tmp_path):
+        state = str(tmp_path / "state")
+        co = Coordinator(state_dir=state)
+        job = co.store.create("/a.y4m", meta=_meta())
+        co.store.update(job.id, lambda j: (
+            setattr(j, "status", Status.RUNNING),
+            setattr(j, "run_token", "tok")))
+        # simulate crash: release handles, new coordinator on same dir
+        co.close()
+        co2 = Coordinator(state_dir=state)
+        assert co2.store.get(job.id).status is Status.RUNNING
+        requeued = co2.recover_jobs()
+        assert requeued == [job.id]
+        j = co2.store.get(job.id)
+        assert j.status is Status.WAITING
+        assert j.run_token == ""
+        assert any("restart" in line.lower() or "requeued" in line.lower()
+                   for line in co2.activity.fetch_job(job.id))
+
+    def test_done_jobs_left_alone(self, tmp_path):
+        state = str(tmp_path / "state")
+        co = Coordinator(state_dir=state)
+        job = co.store.create("/a.y4m")
+        co.store.update(job.id, lambda j: setattr(j, "status", Status.DONE))
+        co.close()
+        co2 = Coordinator(state_dir=state)
+        assert co2.recover_jobs() == []
+        assert co2.store.get(job.id).status is Status.DONE
+        assert os.path.exists(os.path.join(state, "jobs.jsonl"))

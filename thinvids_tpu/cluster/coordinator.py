@@ -129,7 +129,17 @@ class Coordinator:
                  launcher: Callable[[Job], None] | None = None,
                  activity: ActivityLog | None = None,
                  clock: Callable[[], float] = time.time,
-                 settings_fn: Callable[[], Settings] = get_settings) -> None:
+                 settings_fn: Callable[[], Settings] = get_settings,
+                 state_dir: str | None = None) -> None:
+        if state_dir is not None:
+            import os
+
+            os.makedirs(state_dir, exist_ok=True)
+            if store is None:
+                store = JobStore(os.path.join(state_dir, "jobs.jsonl"))
+            if activity is None:
+                activity = ActivityLog(
+                    path=os.path.join(state_dir, "activity.jsonl"))
         self.store = store if store is not None else JobStore()
         self.registry = registry if registry is not None else WorkerRegistry(
             clock=clock)
@@ -219,6 +229,33 @@ class Coordinator:
         job = self.queue_job(job_id)
         self.dispatch_next_waiting_job()
         return self.store.get(job_id)
+
+    def recover_jobs(self) -> list[str]:
+        """Post-restart adoption: any job the journal shows mid-flight
+        (STARTING/RUNNING/STAMPING) has no live executor — wipe its run
+        state and requeue it, exactly as the reference recovered via
+        scheduler adoption + watchdog + restart_job wipe
+        (/root/reference/manager/app.py:1014-1041, 2501-2666). Call once
+        after constructing a persistent coordinator. Returns requeued
+        job ids."""
+        requeued = []
+        for job in self.store.list():
+            if job.status.is_active:
+                self.activity.emit(
+                    "restart", "requeued after coordinator restart "
+                    f"(was {job.status.value})", job_id=job.id)
+                self.restart_job(job.id)
+                requeued.append(job.id)
+        # Jobs persisted while merely WAITING also lost their dispatch
+        # trigger in the crash — kick the scheduler regardless.
+        self.dispatch_next_waiting_job()
+        return requeued
+
+    def close(self) -> None:
+        """Release persistent-state file handles/locks (journal +
+        activity). A closed coordinator must not be used further."""
+        self.store.close()
+        self.activity.close()
 
     def delete_job(self, job_id: str) -> bool:
         with self._sched_lock:

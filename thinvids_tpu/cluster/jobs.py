@@ -10,13 +10,15 @@ run-token fence, heartbeat triple, and failure attribution.
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
 import threading
 import time
 import uuid
 from typing import Any, Callable, Iterator, Mapping
 
 from ..core.status import Status
-from ..core.types import VideoMeta
+from ..core.types import ChromaFormat, VideoMeta
 
 
 def new_run_token() -> str:
@@ -81,18 +83,153 @@ class Job:
             d["meta"] = meta
         return d
 
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "Job":
+        """Inverse of to_dict (the journal restore path). Unknown keys
+        are dropped so old journals survive field additions."""
+        data = dict(d)
+        raw_status = data.get("status")
+        try:
+            data["status"] = Status.parse(raw_status)
+        except ValueError:
+            # A corrupted persisted status must never silently become
+            # schedulable again (core/status.py contract) — surface it
+            # as a failed job with attribution instead.
+            data["status"] = Status.FAILED
+            data.setdefault("failure_stage", "restore")
+            data["failure_reason"] = (
+                f"corrupt persisted status {raw_status!r}")
+        meta = data.get("meta")
+        if meta is not None:
+            meta = dict(meta)
+            meta["chroma"] = ChromaFormat[meta.get("chroma", "YUV420")]
+            known_m = {f.name for f in dataclasses.fields(VideoMeta)}
+            data["meta"] = VideoMeta(
+                **{k: v for k, v in meta.items() if k in known_m})
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in data.items() if k in known})
+
 
 class JobStore:
-    """Thread-safe in-process job index.
+    """Thread-safe job index, optionally journal-backed.
 
     The update() path takes the store lock and hands the caller the live
     record — the analog of the reference's HSET read-modify-write under
     its scheduler lock. Snapshots returned by get()/list() are copies.
+
+    With `path` set, every mutation appends a JSON line
+    (``{"op": "put"|"del", ...}``) to the journal, and construction
+    replays it — the durable-state role Redis played for the reference
+    (SURVEY.md §5.4: the job hash IS the job's checkpoint). The journal
+    is compacted to one line per live job on open and whenever it grows
+    past ~10x the live set.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, path: str | None = None) -> None:
         self._lock = threading.RLock()
         self._jobs: dict[str, Job] = {}
+        self._path = path
+        self._journal: Any = None
+        self._lockfile: Any = None
+        self._journal_lines = 0
+        self._closed = False
+        if path:
+            self._acquire_lockfile()
+            try:
+                self._replay()
+                self._compact_locked()
+            except BaseException:
+                self.close()           # don't leak the flock on failure
+                raise
+
+    def _acquire_lockfile(self) -> None:
+        """Exclusive-own the journal via flock on a sidecar lock file
+        (never replaced, so compaction can't orphan the lock). A second
+        store over the same path would otherwise os.replace the journal
+        out from under the first one's append handle — both would then
+        'durably' write divergent state."""
+        import fcntl
+
+        self._lockfile = open(self._path + ".lock", "w")
+        try:
+            fcntl.flock(self._lockfile, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            self._lockfile.close()
+            self._lockfile = None
+            raise RuntimeError(
+                f"job journal {self._path} is owned by another store "
+                "(close() it first)")
+
+    def close(self) -> None:
+        """Release the journal handle and ownership lock. Further
+        mutations raise — a closed store must never silently reopen the
+        journal without the lock."""
+        with self._lock:
+            self._closed = True
+            if self._journal is not None:
+                self._journal.close()
+                self._journal = None
+            if self._lockfile is not None:
+                import fcntl
+
+                fcntl.flock(self._lockfile, fcntl.LOCK_UN)
+                self._lockfile.close()
+                self._lockfile = None
+
+    # -- journal -------------------------------------------------------
+
+    def _replay(self) -> None:
+        if not os.path.exists(self._path):
+            return
+        with open(self._path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                    if rec.get("op") == "put":
+                        job = Job.from_dict(rec["job"])
+                        self._jobs[job.id] = job
+                    elif rec.get("op") == "del":
+                        self._jobs.pop(rec.get("id"), None)
+                except Exception:     # noqa: BLE001 - skip the one bad
+                    continue          # record (torn write / bit rot),
+                                      # never abort the whole replay
+
+    def _compact_locked(self) -> None:
+        """Rewrite the journal as one put per live job (atomic rename)."""
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
+        tmp = self._path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            for job in self._jobs.values():
+                fh.write(json.dumps({"op": "put", "job": job.to_dict()})
+                         + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self._path)
+        self._journal = open(self._path, "a", encoding="utf-8")
+        self._journal_lines = len(self._jobs)
+
+    def _log_locked(self, rec: dict[str, Any]) -> None:
+        if not self._path:
+            return
+        if self._closed:
+            raise RuntimeError(
+                "JobStore is closed; mutation after close() would write "
+                "the journal without the ownership lock")
+        if self._journal is None:
+            self._journal = open(self._path, "a", encoding="utf-8")
+        self._journal.write(json.dumps(rec) + "\n")
+        self._journal.flush()
+        self._journal_lines += 1
+        if self._journal_lines > max(1000, 10 * len(self._jobs)):
+            self._compact_locked()
+
+    def _log_put_locked(self, job: Job) -> None:
+        self._log_locked({"op": "put", "job": job.to_dict()})
 
     def create(self, input_path: str, meta: VideoMeta | None = None,
                settings: Mapping[str, Any] | None = None,
@@ -103,6 +240,7 @@ class JobStore:
             if job.id in self._jobs:
                 raise ValueError(f"duplicate job id {job.id}")
             self._jobs[job.id] = job
+            self._log_put_locked(job)
         return self.get(job.id)
 
     def get(self, job_id: str) -> Job:
@@ -126,11 +264,15 @@ class JobStore:
             if job is None:
                 raise KeyError(f"no such job {job_id}")
             fn(job)
+            self._log_put_locked(job)
             return dataclasses.replace(job)
 
     def delete(self, job_id: str) -> bool:
         with self._lock:
-            return self._jobs.pop(job_id, None) is not None
+            gone = self._jobs.pop(job_id, None) is not None
+            if gone:
+                self._log_locked({"op": "del", "id": job_id})
+            return gone
 
     def list(self, status: Status | None = None) -> list[Job]:
         with self._lock:
