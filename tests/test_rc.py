@@ -1,0 +1,124 @@
+"""Rate control: psum complexity exchange + per-GOP QP + 2-pass VBR.
+
+BASELINE config 4's shape: per-GOP rate-control stats exchanged with
+`jax.lax.psum` over the gop mesh axis, per-GOP QPs solved against a
+bitrate target, slice headers carrying the deltas. Decisions must be
+identical sharded vs single-device, and the 2-pass output must land
+within ±10% of the target on synthetic content.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from thinvids_tpu.core.types import Frame, VideoMeta, concat_segments
+from thinvids_tpu.parallel import rc
+from thinvids_tpu.parallel.dispatch import GopShardEncoder
+from jax.sharding import Mesh
+
+
+def _clip(n=32, w=128, h=64, seed=0):
+    """Half flat / half busy content so complexity shares differ."""
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:h, 0:w]
+    frames = []
+    for i in range(n):
+        if i < n // 2:
+            y = np.full((h, w), 120, np.uint8)       # flat, cheap GOPs
+        else:
+            y = ((xx * 3 + yy + 5 * i) % 256).astype(np.uint8)
+            y = np.clip(y + rng.integers(-20, 21, (h, w)), 0,
+                        255).astype(np.uint8)        # busy GOPs
+        frames.append(Frame(
+            y=y, u=np.full((h // 2, w // 2), 110, np.uint8),
+            v=np.full((h // 2, w // 2), 140, np.uint8)))
+    meta = VideoMeta(width=w, height=h, fps_num=30, fps_den=1,
+                     num_frames=n)
+    return frames, meta
+
+
+class TestComplexityShares:
+    def test_sharded_matches_single_device(self):
+        frames, meta = _clip()
+        enc8 = GopShardEncoder(meta, qp=27, gop_frames=4)
+        assert enc8.num_devices == 8
+        single = Mesh(np.array(jax.devices()[:1]), ("gop",))
+        enc1 = GopShardEncoder(meta, qp=27, mesh=single, gop_frames=4,
+                               gops_per_wave=8)
+        s8 = rc.analyze_complexity(enc8, frames)
+        s1 = rc.analyze_complexity(enc1, frames)
+        assert len(s8) == 8
+        np.testing.assert_allclose(s8, s1, rtol=1e-5)
+        assert abs(s8.sum() - 1.0) < 1e-6
+        # busy half must carry most of the complexity
+        assert s8[4:].sum() > 0.9
+
+    def test_qp_decisions_identical_sharded_vs_single(self):
+        frames, meta = _clip()
+        single = Mesh(np.array(jax.devices()[:1]), ("gop",))
+        encs = [GopShardEncoder(meta, qp=27, gop_frames=4),
+                GopShardEncoder(meta, qp=27, mesh=single, gop_frames=4,
+                                gops_per_wave=8)]
+        qps = []
+        for enc in encs:
+            shares = rc.analyze_complexity(enc, frames)
+            segs = enc.encode_waves(enc.stage_waves(frames))
+            nbytes = np.asarray([len(s.payload) for s in segs], np.float64)
+            qps.append(rc.solve_gop_qps(27, nbytes, shares, 100_000.0))
+        np.testing.assert_array_equal(qps[0], qps[1])
+
+
+class TestPerGopQp:
+    def test_per_gop_qp_stream_decodes_and_obeys_qp(self):
+        from thinvids_tpu.tools import oracle
+
+        frames, meta = _clip()
+        enc = GopShardEncoder(meta, qp=27, gop_frames=4)
+        n_gops = enc.plan(len(frames)).num_gops
+        enc.gop_qp = {i: (20 if i % 2 == 0 else 36) for i in range(n_gops)}
+        segs = enc.encode_waves(enc.stage_waves(frames))
+        stream = concat_segments(segs)
+        # lower-QP GOPs must spend more bits than same-content higher-QP
+        # ones: compare the two busy-half pairs
+        busy = sorted(segs[4:], key=lambda s: s.gop.index)
+        low = [s for s in busy if enc.gop_qp[s.gop.index] == 20]
+        high = [s for s in busy if enc.gop_qp[s.gop.index] == 36]
+        assert min(len(p.payload) for p in low) > \
+            max(len(p.payload) for p in high)
+        if oracle.oracle_available():
+            assert len(oracle.decode_h264(stream)) == len(frames)
+
+    def test_base_qp_unchanged_bit_identity(self):
+        # gop_qp empty -> byte-identical to the pre-rate-control path
+        frames, meta = _clip(n=8)
+        enc = GopShardEncoder(meta, qp=27, gop_frames=4)
+        a = concat_segments(enc.encode_waves(enc.stage_waves(frames)))
+        from thinvids_tpu.parallel.dispatch import encode_clip_sharded
+        b = encode_clip_sharded(frames, meta, qp=27, gop_frames=4)
+        assert a == b
+
+
+class TestVbr2Pass:
+    @pytest.mark.parametrize("target_kbps", [200.0, 600.0])
+    def test_hits_bitrate_within_10pct(self, target_kbps):
+        frames, meta = _clip()
+        segs, stats = rc.encode_vbr2pass(frames, meta, target_kbps,
+                                         base_qp=27, gop_frames=4)
+        assert len(segs) == 8
+        err = abs(stats["pass2_bits"] - stats["target_bits"]) \
+            / stats["target_bits"]
+        assert err < 0.10, stats
+        # busy GOPs must get lower (or equal) QP than flat ones
+        qps = stats["gop_qps"]
+        assert min(qps[4:]) <= min(qps[:4])
+
+    def test_unreachable_target_saturates_at_qp_floor(self):
+        # this clip cannot produce 5 Mbps even at QP_MIN: the solver
+        # must stop at the floor instead of spinning through passes
+        frames, meta = _clip()
+        segs, stats = rc.encode_vbr2pass(frames, meta, 5000.0,
+                                         base_qp=27, gop_frames=4)
+        assert all(q == rc.QP_MIN for q in stats["gop_qps"])
+        assert stats["passes"] <= 4
+        assert stats["pass2_bits"] < stats["target_bits"]

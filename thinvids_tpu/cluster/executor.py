@@ -103,8 +103,13 @@ class LocalExecutor:
                              note=f"{plan.num_gops} GOPs planned")
 
             stage = "encode"
-            segments = self._encode_with_retry(job, token, enc, frames,
-                                               settings)
+            target_kbps = float(settings.get("target_bitrate_kbps", 0.0))
+            if str(settings.rc_mode) == "vbr2pass" and target_kbps > 0:
+                segments = self._encode_vbr2pass(
+                    job, token, enc, frames, settings, meta, target_kbps)
+            else:
+                segments = self._encode_with_retry(job, token, enc,
+                                                   frames, settings)
 
             stage = "stitch"
             co.heartbeat_job(job.id, token, stage, host=self.host)
@@ -125,6 +130,29 @@ class LocalExecutor:
         except Exception as exc:            # noqa: BLE001 - attribute & fail
             co.fail_job(job.id, token, stage=stage, host=self.host,
                         reason=f"{type(exc).__name__}: {exc}")
+
+    def _encode_vbr2pass(self, job: Job, token: str, enc, frames,
+                         settings, meta, target_kbps: float) -> list:
+        """Two-pass VBR via rc.encode_vbr2pass's single solve/refine
+        loop, with every pass riding this executor's retry/halt/progress
+        wrapper and heartbeating its pass number."""
+        from ..parallel import rc
+
+        co = self.coordinator
+
+        def on_pass(pass_no, gop_qps):
+            note = ("vbr pass 1 (analysis)" if gop_qps is None else
+                    f"vbr pass {pass_no} (qp {gop_qps.min()}"
+                    f"-{gop_qps.max()})")
+            co.heartbeat_job(job.id, token, "encode", host=self.host,
+                             note=note)
+
+        segments, _stats = rc.encode_vbr2pass(
+            frames, meta, target_kbps, base_qp=int(settings.qp), enc=enc,
+            encode_fn=lambda e: self._encode_with_retry(
+                job, token, e, frames, settings),
+            on_pass=on_pass)
+        return segments
 
     def _encode_with_retry(self, job: Job, token: str, enc, frames,
                            settings) -> list:

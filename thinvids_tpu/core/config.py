@@ -38,6 +38,7 @@ DEFAULT_SETTINGS: dict[str, Any] = {
     "max_segments": 200,
     # encoder operating point (analog of VEM_* env knobs)
     "rc_mode": "cqp",                # cqp | vbr2pass
+    "target_bitrate_kbps": 0.0,      # vbr2pass target; 0 = unset
     "qp": 27,
     "target_height": 1080,
     "software_fallback": True,       # pure-JAX CPU path when no TPU
@@ -113,6 +114,7 @@ _CLAMPS: dict[str, Callable[[Any], Any]] = {
     if as_int(v, 1080) in (480, 576, 720, 1080, 2160)
     else 1080,
     "rc_mode": lambda v: str(v) if str(v) in ("cqp", "vbr2pass") else "cqp",
+    "target_bitrate_kbps": lambda v: min(500_000.0, max(0.0, as_float(v, 0.0))),
     "large_file_behavior": lambda v: str(v)
     if str(v) in ("reject", "direct", "nfs")
     else "direct",
@@ -231,7 +233,7 @@ def reset_live_settings() -> None:
 # (/root/reference/manager/app.py:2746-2812).
 JOB_SETTING_KEYS = frozenset(
     {"gop_frames", "target_segment_frames", "qp", "target_height", "rc_mode",
-     "max_segments", "software_fallback"}
+     "target_bitrate_kbps", "max_segments", "software_fallback"}
 )
 
 

@@ -94,62 +94,63 @@ def _unflatten_gop(flat: np.ndarray, mv8: np.ndarray, num_frames: int,
 
 
 @functools.partial(jax.jit, static_argnames=("mbw", "mbh", "mesh"))
-def _encode_wave_gop(ys, us, vs, qp, *, mbw: int, mbh: int, mesh: Mesh):
+def _encode_wave_gop(ys, us, vs, qps, *, mbw: int, mbh: int, mesh: Mesh):
     """ys: (G, F, H, W) uint8 sharded over `gop`, G = devices x k; each
-    device sequentially encodes its k GOPs (IDR + P, jaxinter) and
-    sparse-packs the plane-layout levels."""
+    device sequentially encodes its k GOPs (IDR + P, jaxinter) at its
+    per-GOP QP (qps: (G,) int32, the rate-control hook) and sparse-packs
+    the plane-layout levels."""
 
-    def per_dev(y_g, u_g, v_g):
+    def per_dev(y_g, u_g, v_g, qp_g):
         def one(args):
-            y, u, v = args
+            y, u, v, qp = args
             return _per_gop_sparse(y, u, v, qp, mbw, mbh)
-        return jax.lax.map(one, (y_g, u_g, v_g))
+        return jax.lax.map(one, (y_g, u_g, v_g, qp_g))
 
     shard = jax.shard_map(
         per_dev, mesh=mesh,
-        in_specs=(P("gop"), P("gop"), P("gop")),
+        in_specs=(P("gop"),) * 4,
         out_specs=(P("gop"),) * 7,
     )
-    return shard(ys, us, vs)
+    return shard(ys, us, vs, qps)
 
 
 @functools.partial(jax.jit, static_argnames=("mbw", "mbh"))
-def _encode_gop_single(ys, us, vs, qp, *, mbw: int, mbh: int):
+def _encode_gop_single(ys, us, vs, qps, *, mbw: int, mbh: int):
     """Single-device wave: the same per-GOP program WITHOUT the
     shard_map wrapper. On one chip shard_map buys nothing and costs a
     lot — measured on TPU v5e: compile 33 s → 810 s and steady-state
     256 ms → 800 ms per 1080p GOP under the manual-axes lowering."""
     def one(args):
-        y, u, v = args
+        y, u, v, qp = args
         return _per_gop_sparse(y, u, v, qp, mbw, mbh)
-    return jax.lax.map(one, (ys, us, vs))
+    return jax.lax.map(one, (ys, us, vs, qps))
 
 
 @functools.partial(jax.jit, static_argnames=("mbw", "mbh", "dtype"))
-def _encode_gop_single_dense(ys, us, vs, qp, *, mbw: int, mbh: int, dtype):
+def _encode_gop_single_dense(ys, us, vs, qps, *, mbw: int, mbh: int, dtype):
     def one(args):
-        y, u, v = args
+        y, u, v, qp = args
         return _per_gop_dense(y, u, v, qp, mbw, mbh, dtype)
-    return jax.lax.map(one, (ys, us, vs))
+    return jax.lax.map(one, (ys, us, vs, qps))
 
 
 @functools.partial(jax.jit, static_argnames=("mbw", "mbh", "mesh", "dtype"))
-def _encode_wave_gop_dense(ys, us, vs, qp, *, mbw: int, mbh: int, mesh: Mesh,
+def _encode_wave_gop_dense(ys, us, vs, qps, *, mbw: int, mbh: int, mesh: Mesh,
                            dtype):
     """Dense fallback for the GOP wave: (G, L) levels in `dtype`."""
 
-    def per_dev(y_g, u_g, v_g):
+    def per_dev(y_g, u_g, v_g, qp_g):
         def one(args):
-            y, u, v = args
+            y, u, v, qp = args
             return _per_gop_dense(y, u, v, qp, mbw, mbh, dtype)
-        return jax.lax.map(one, (y_g, u_g, v_g))
+        return jax.lax.map(one, (y_g, u_g, v_g, qp_g))
 
     shard = jax.shard_map(
         per_dev, mesh=mesh,
-        in_specs=(P("gop"), P("gop"), P("gop")),
+        in_specs=(P("gop"),) * 4,
         out_specs=P("gop"),
     )
-    return shard(ys, us, vs)
+    return shard(ys, us, vs, qps)
 
 
 @functools.partial(jax.jit, static_argnames=("mbw", "mbh", "mesh"))
@@ -226,6 +227,10 @@ class GopShardEncoder:
                        fps_num=meta.fps_num, fps_den=meta.fps_den)
         self.pps = PPS(init_qp=qp)
         self._qp_arr = jnp.asarray(qp)      # hoisted: one upload per clip
+        #: Optional per-GOP QP overrides (rate control): gop index → qp.
+        #: GOPs absent from the map encode at the base `qp`; slice
+        #: headers carry the delta vs PPS init_qp.
+        self.gop_qp: dict[int, int] = {}
 
     @property
     def num_devices(self) -> int:
@@ -249,6 +254,28 @@ class GopShardEncoder:
             raise ValueError(
                 f"GopShardEncoder supports only 4:2:0 input, got "
                 f"{bad.chroma.name}; convert before encoding")
+        for wave, full, F, padded in self._wave_groups(frames):
+            ys = np.stack([self._gop_plane(padded, g, F, "y") for g in full])
+            us = np.stack([self._gop_plane(padded, g, F, "u") for g in full])
+            vs = np.stack([self._gop_plane(padded, g, F, "v") for g in full])
+            qps = np.asarray([self.gop_qp.get(g.index, self.qp)
+                              for g in full], np.int32)
+            yield (wave, jnp.asarray(ys), jnp.asarray(us), jnp.asarray(vs),
+                   jnp.asarray(qps))
+
+    def stage_luma_waves(self, frames: list[Frame]):
+        """Luma-only staging for analysis passes (rate control): chroma
+        never leaves the host, halving the upload of a pass that only
+        reads Y. Yields (wave, ys)."""
+        for wave, full, F, padded in self._wave_groups(frames):
+            ys = np.stack([self._gop_plane(padded, g, F, "y") for g in full])
+            yield (wave, jnp.asarray(ys))
+
+    def _wave_groups(self, frames: list[Frame]):
+        """Shared wave grouping: (wave, device-padded wave, static F,
+        padded frames). Stacks into (G, F, ...) with tail-repeat padding
+        to static F; the wave itself pads to a multiple of D gops (the
+        pad GOPs are encoded then discarded)."""
         plan = self.plan(len(frames))
         padded = [f.padded(16) for f in frames]
         D = self.num_devices
@@ -257,16 +284,9 @@ class GopShardEncoder:
         for wave_start in range(0, len(gops), per_wave):
             wave = gops[wave_start:wave_start + per_wave]
             F = max(g.num_frames for g in wave)
-            # Stack into (G, F, ...) with tail-repeat padding to static F,
-            # and pad the wave itself to a multiple of D gops (the pad
-            # GOPs are encoded then discarded).
-            pad_gop = wave[-1]
             pad_n = (-len(wave)) % D
-            full = wave + [pad_gop] * pad_n
-            ys = np.stack([self._gop_plane(padded, g, F, "y") for g in full])
-            us = np.stack([self._gop_plane(padded, g, F, "u") for g in full])
-            vs = np.stack([self._gop_plane(padded, g, F, "v") for g in full])
-            yield (wave, jnp.asarray(ys), jnp.asarray(us), jnp.asarray(vs))
+            full = wave + [wave[-1]] * pad_n
+            yield wave, full, F, padded
 
     def prepare_waves(self, frames: list[Frame]
                       ) -> tuple[SegmentPlan, list[tuple]]:
@@ -280,16 +300,17 @@ class GopShardEncoder:
     def dispatch_wave(self, staged: tuple) -> tuple:
         """Enqueue one staged wave's device compute (async); returns an
         opaque pending handle for :meth:`collect_wave`."""
-        wave, ysd, usd, vsd = staged
-        qp = self._qp_arr
+        wave, ysd, usd, vsd, qpsd = staged
         ph, pw = ysd.shape[2], ysd.shape[3]
         mbh, mbw = ph // 16, pw // 16
         if self.inter and self.num_devices == 1:
-            out = _encode_gop_single(ysd, usd, vsd, qp, mbw=mbw, mbh=mbh)
+            out = _encode_gop_single(ysd, usd, vsd, qpsd, mbw=mbw, mbh=mbh)
+        elif self.inter:
+            out = _encode_wave_gop(ysd, usd, vsd, qpsd, mbw=mbw, mbh=mbh,
+                                   mesh=self.mesh)
         else:
-            wave_fn = _encode_wave_gop if self.inter else _encode_wave
-            out = wave_fn(ysd, usd, vsd, qp, mbw=mbw, mbh=mbh,
-                          mesh=self.mesh)
+            out = _encode_wave(ysd, usd, vsd, self._qp_arr, mbw=mbw,
+                               mbh=mbh, mesh=self.mesh)
         for arr in out:
             # Start the device->host copies now, overlapped with the next
             # wave's compute (the transfer link has high latency — axon
@@ -298,12 +319,12 @@ class GopShardEncoder:
                 arr.copy_to_host_async()
             except Exception:       # noqa: BLE001 - best-effort prefetch
                 pass
-        return (wave, ysd, usd, vsd, mbw, mbh, out)
+        return (wave, ysd, usd, vsd, qpsd, mbw, mbh, out)
 
     def collect_wave(self, pending: tuple) -> list[EncodedSegment]:
         """Fetch one dispatched wave's levels (sparse, with the dense
         fallback) and entropy-pack its GOPs on host."""
-        wave, ysd, usd, vsd, mbw, mbh, out = pending
+        wave, ysd, usd, vsd, qpsd, mbw, mbh, out = pending
         segments: list[EncodedSegment] = []
         F = ysd.shape[1]
         nmb = mbw * mbh
@@ -319,15 +340,23 @@ class GopShardEncoder:
         if not sparse_ok:
             if self.inter and self.num_devices == 1:
                 flat = jax.device_get(_encode_gop_single_dense(
-                    ysd, usd, vsd, jnp.asarray(self.qp), mbw=mbw,
-                    mbh=mbh, dtype=jnp.int16))
+                    ysd, usd, vsd, qpsd, mbw=mbw, mbh=mbh,
+                    dtype=jnp.int16))
+            elif self.inter:
+                flat = jax.device_get(_encode_wave_gop_dense(
+                    ysd, usd, vsd, qpsd, mbw=mbw, mbh=mbh,
+                    mesh=self.mesh, dtype=jnp.int16))
             else:
-                dense_fn = (_encode_wave_gop_dense if self.inter
-                            else _encode_wave_dense)
-                flat = jax.device_get(dense_fn(
+                flat = jax.device_get(_encode_wave_dense(
                     ysd, usd, vsd, jnp.asarray(self.qp), mbw=mbw,
                     mbh=mbh, mesh=self.mesh, dtype=jnp.int16))
+        # Header QP must match what the device QUANTIZED with — read it
+        # from the staged per-wave array, not the live gop_qp dict (a
+        # caller mutating gop_qp between passes must not desync slices
+        # already in flight).
+        qps_host = np.asarray(qpsd)
         for gi, gop in enumerate(wave):
+            gop_qp = int(qps_host[gi])
             if self.inter:
                 if sparse_ok:
                     raw = jaxcore._block_sparse_unpack(
@@ -335,7 +364,8 @@ class GopShardEncoder:
                         vals[gi], esc_pos[gi], esc_val[gi], L)
                 else:
                     raw = flat[gi]
-                payload = self._pack_gop(gop, mv8[gi], raw, F, mbw, mbh)
+                payload = self._pack_gop(gop, mv8[gi], raw, F, mbw, mbh,
+                                         qp=gop_qp)
             else:
                 payload = []
                 for fi in range(gop.num_frames):
@@ -405,15 +435,19 @@ class GopShardEncoder:
         return segments
 
     def _pack_gop(self, gop: GopSpec, mv8: np.ndarray, flat: np.ndarray,
-                  F: int, mbw: int, mbh: int) -> list[bytes]:
-        """Entropy-pack one GOP (IDR + P slices) from its flat levels."""
+                  F: int, mbw: int, mbh: int,
+                  qp: int | None = None) -> list[bytes]:
+        """Entropy-pack one GOP (IDR + P slices) from its flat levels.
+        `qp` must match the QP the device quantized this GOP with (the
+        slice headers carry its delta vs PPS init_qp)."""
         from ..codecs.h264.encoder import pack_gop_slices_planes
 
         intra, planes = _unflatten_gop(flat, mv8, F, mbw, mbh)
         # gop.num_frames (not F) drops the wave's tail-repeat padding.
         return pack_gop_slices_planes(intra, planes, gop.num_frames,
                                       mbw, mbh, self.sps, self.pps,
-                                      self.qp, idr_pic_id=gop.index)
+                                      self.qp if qp is None else qp,
+                                      idr_pic_id=gop.index)
 
     @staticmethod
     def _gop_plane(padded: list[Frame], gop: GopSpec, F: int, plane: str
