@@ -59,8 +59,24 @@ def make_frames(n: int, w: int, h: int, seed: int = 0, pan: int = 3):
     return frames
 
 
-def _run_pipeline(w: int, h: int, nframes: int, qp: int, gop_frames: int):
-    """(e2e fps, device-only fps, total bytes) for one resolution."""
+def _quality(frames, stream) -> dict:
+    """Luma PSNR/SSIM of the encoded stream vs source (libavcodec
+    oracle decode; outside every timed region)."""
+    from thinvids_tpu.tools import oracle
+    from thinvids_tpu.tools.metrics import clip_quality
+
+    if not oracle.oracle_available():
+        return {}
+    decoded = oracle.decode_h264(stream)
+    q = clip_quality(frames, [d[0] for d in decoded])
+    return {"psnr_y": round(q["psnr_y"], 2),
+            "ssim_y": round(q["ssim_y"], 4)}
+
+
+def _run_pipeline(w: int, h: int, nframes: int, qp: int, gop_frames: int,
+                  quality: bool = True):
+    """(e2e fps, device-only fps, total bytes, quality) for one
+    resolution."""
     import jax
 
     from thinvids_tpu.core.types import VideoMeta, concat_segments
@@ -95,7 +111,8 @@ def _run_pipeline(w: int, h: int, nframes: int, qp: int, gop_frames: int):
     t0 = time.perf_counter()
     stream = concat_segments(enc.encode_waves(waves))
     t_e2e = time.perf_counter() - t0
-    return nframes / t_e2e, nframes / t_dev, len(stream)
+    return (nframes / t_e2e, nframes / t_dev, len(stream),
+            _quality(frames, stream) if quality else {})
 
 
 def main() -> None:
@@ -105,10 +122,12 @@ def main() -> None:
     qp, gop = 27, 8
 
     n_1080 = 48
-    fps, dev_fps, nbytes = _run_pipeline(1920, 1080, n_1080, qp, gop)
+    fps, dev_fps, nbytes, quality = _run_pipeline(1920, 1080, n_1080, qp,
+                                                  gop)
 
     n_4k = 16
-    fps_4k, dev_fps_4k, _ = _run_pipeline(3840, 2160, n_4k, qp, gop)
+    fps_4k, dev_fps_4k, _, _ = _run_pipeline(3840, 2160, n_4k, qp, gop,
+                                             quality=False)
 
     result = {
         "metric": "h264_gop_1080p_fps",
@@ -123,6 +142,7 @@ def main() -> None:
         "qp": qp,
         "gop_frames": gop,
         "frames": n_1080,
+        **quality,
     }
     print(json.dumps(result))
 

@@ -1,0 +1,73 @@
+"""Quality metrics sanity: PSNR/SSIM behave like the standard
+definitions and the encoder's output lands in the expected band."""
+
+import numpy as np
+import pytest
+
+from thinvids_tpu.tools.metrics import clip_quality, psnr, ssim
+
+
+class TestPsnr:
+    def test_identical_is_inf(self):
+        x = np.random.default_rng(0).integers(0, 256, (64, 64)).astype(np.uint8)
+        assert psnr(x, x) == float("inf")
+
+    def test_known_value(self):
+        ref = np.zeros((16, 16), np.uint8)
+        dist = np.full((16, 16), 10, np.uint8)   # mse=100
+        assert abs(psnr(ref, dist) - 10 * np.log10(255**2 / 100)) < 1e-9
+
+    def test_monotone_in_noise(self):
+        rng = np.random.default_rng(1)
+        x = rng.integers(0, 256, (64, 64)).astype(np.float64)
+        a = psnr(x, np.clip(x + rng.normal(0, 2, x.shape), 0, 255))
+        b = psnr(x, np.clip(x + rng.normal(0, 8, x.shape), 0, 255))
+        assert a > b
+
+
+class TestSsim:
+    def test_identical_is_one(self):
+        x = np.random.default_rng(0).integers(0, 256, (64, 64)).astype(np.uint8)
+        assert abs(ssim(x, x) - 1.0) < 1e-12
+
+    def test_noise_degrades(self):
+        rng = np.random.default_rng(1)
+        x = rng.integers(0, 256, (64, 64)).astype(np.float64)
+        noisy = np.clip(x + rng.normal(0, 20, x.shape), 0, 255)
+        s = ssim(x, noisy)
+        assert 0.0 < s < 0.99
+
+    def test_constant_shift_nearly_one(self):
+        # SSIM is mean-shift tolerant (luminance term saturates)
+        x = np.random.default_rng(2).integers(40, 200, (64, 64)).astype(float)
+        assert ssim(x, x + 3) > 0.97
+
+
+class TestEncoderQuality:
+    def test_qp27_band_on_synthetic_content(self):
+        from thinvids_tpu.core.types import Frame, VideoMeta
+        from thinvids_tpu.parallel.dispatch import encode_clip_sharded
+        from thinvids_tpu.tools import oracle
+
+        if not oracle.oracle_available():
+            pytest.skip("libavcodec missing")
+        rng = np.random.default_rng(3)
+        h, w, n = 48, 64, 8
+        yy, xx = np.mgrid[0:h, 0:w]
+        frames = [Frame(
+            y=np.clip((xx * 2 + 3 * i) % 200 +
+                      rng.integers(-10, 11, (h, w)), 0, 255).astype(np.uint8),
+            u=np.full((h // 2, w // 2), 110, np.uint8),
+            v=np.full((h // 2, w // 2), 140, np.uint8)) for i in range(n)]
+        meta = VideoMeta(width=w, height=h, num_frames=n)
+        stream = encode_clip_sharded(frames, meta, qp=27, gop_frames=4)
+        decoded = oracle.decode_h264(stream)
+        q = clip_quality(frames, [d[0] for d in decoded])
+        assert q["frames_compared"] == n
+        assert 28.0 < q["psnr_y"] < 60.0        # lossy but reasonable
+        assert 0.75 < q["ssim_y"] <= 1.0
+        # lower QP must not reduce quality
+        stream_hi = encode_clip_sharded(frames, meta, qp=18, gop_frames=4)
+        q_hi = clip_quality(frames,
+                            [d[0] for d in oracle.decode_h264(stream_hi)])
+        assert q_hi["psnr_y"] > q["psnr_y"]

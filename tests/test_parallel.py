@@ -180,6 +180,30 @@ class TestShardedInterDispatch:
                 idr_pic_id=gop.index))
         assert got == b"".join(parts)
 
+    def test_block_sparse2_roundtrip(self):
+        # two-tier device pack <-> host unpack over mixed content incl.
+        # escapes (|v| > 127) and a non-multiple-of-16 length
+        from thinvids_tpu.codecs.h264 import jaxcore
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(5)
+        L = 16 * 1000 + 8
+        flat = np.zeros(L, np.int32)
+        # residual-like content: nonzeros cluster in a few blocks
+        # (uniform scatter would blow the block budget by design)
+        hot_blocks = rng.choice(200, 120, replace=False)
+        for b in hot_blocks:
+            lanes = rng.choice(16, rng.integers(1, 6), replace=False)
+            flat[b * 16 + lanes] = rng.integers(-300, 301, len(lanes))
+        out = jaxcore._block_sparse_pack2(jnp.asarray(flat))
+        nblk, nval, n_esc, bitmap, bmask16, vals, esc_pos, esc_val = \
+            [np.asarray(x) for x in out]
+        assert jaxcore.block_sparse2_fits(nblk, nval, n_esc, L)
+        back = jaxcore._block_sparse_unpack2(
+            int(nblk), int(nval), int(n_esc), bitmap, bmask16, vals,
+            esc_pos, esc_val, L)
+        np.testing.assert_array_equal(back, flat.astype(np.int16))
+
     def test_sharded_gop_odd_mb_count(self):
         # 80x48 -> 5x3 = 15 MBs (odd): the GOP flat level vector length
         # is then not a multiple of the 16-coeff sparse block, which the

@@ -46,11 +46,11 @@ def _flat_levels(y, u, v, qp, mbw, mbh):
 
 
 def _per_gop_sparse(y, u, v, qp, mbw: int, mbh: int):
-    """(F, H, W) GOP → (mv int8, block-sparse plane-layout levels)."""
+    """(F, H, W) GOP → (mv int8, two-tier sparse plane-layout levels)."""
     from ..codecs.h264 import jaxinter
 
     mv8, flat = jaxinter.encode_gop_planes(y, u, v, qp, mbw=mbw, mbh=mbh)
-    return (mv8,) + jaxcore._block_sparse_pack(flat)
+    return (mv8,) + jaxcore._block_sparse_pack2(flat)
 
 
 def _per_gop_dense(y, u, v, qp, mbw: int, mbh: int, dtype):
@@ -109,7 +109,7 @@ def _encode_wave_gop(ys, us, vs, qps, *, mbw: int, mbh: int, mesh: Mesh):
     shard = jax.shard_map(
         per_dev, mesh=mesh,
         in_specs=(P("gop"),) * 4,
-        out_specs=(P("gop"),) * 7,
+        out_specs=(P("gop"),) * 9,
     )
     return shard(ys, us, vs, qps)
 
@@ -331,9 +331,10 @@ class GopShardEncoder:
         L = (nmb * _INTRA_MB + (F - 1) * nmb * _P_FLAT_MB if self.inter
              else nmb * _INTRA_MB)
         if self.inter:
-            mv8, nnz, n_esc, bitmap, vals, esc_pos, esc_val = \
-                jax.device_get(out)
-            sparse_ok = jaxcore.block_sparse_fits(nnz.max(), n_esc.max(), L)
+            (mv8, nblk, nval, n_esc, bitmap, bmask16, vals, esc_pos,
+             esc_val) = jax.device_get(out)
+            sparse_ok = jaxcore.block_sparse2_fits(
+                nblk.max(), nval.max(), n_esc.max(), L)
         else:
             nnz, n_esc, bitmap, vals, esc_pos, esc_val = jax.device_get(out)
             sparse_ok = jaxcore.sparse_fits(nnz.max(), n_esc.max(), L)
@@ -359,9 +360,10 @@ class GopShardEncoder:
             gop_qp = int(qps_host[gi])
             if self.inter:
                 if sparse_ok:
-                    raw = jaxcore._block_sparse_unpack(
-                        int(nnz[gi]), int(n_esc[gi]), bitmap[gi],
-                        vals[gi], esc_pos[gi], esc_val[gi], L)
+                    raw = jaxcore._block_sparse_unpack2(
+                        int(nblk[gi]), int(nval[gi]), int(n_esc[gi]),
+                        bitmap[gi], bmask16[gi], vals[gi], esc_pos[gi],
+                        esc_val[gi], L)
                 else:
                     raw = flat[gi]
                 payload = self._pack_gop(gop, mv8[gi], raw, F, mbw, mbh,

@@ -1,0 +1,80 @@
+"""Objective quality metrics: PSNR and SSIM (numpy, host-side).
+
+The reference had no quality instrumentation at all — output quality
+was judged by eye off the preview player (SURVEY.md §4); the driver
+metric ("VMAF parity", BASELINE.md) demands numbers. VMAF itself needs
+its trained model files (not in this image), so the harness reports
+PSNR + SSIM — the standard proxies VMAF correlates with — computed
+against the source on every bench run so quality regressions are
+visible next to fps.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def psnr(ref: np.ndarray, dist: np.ndarray, peak: float = 255.0) -> float:
+    """Peak signal-to-noise ratio in dB (inf for identical planes)."""
+    ref = ref.astype(np.float64)
+    dist = dist.astype(np.float64)
+    mse = np.mean((ref - dist) ** 2)
+    if mse == 0:
+        return float("inf")
+    return float(10.0 * np.log10(peak * peak / mse))
+
+
+def _uniform_filter(x: np.ndarray, size: int) -> np.ndarray:
+    """Separable box filter via cumulative sums ('same' shape for any
+    window size, edge-padded) — keeps the module dependency-free on a
+    1-core host."""
+    pad_l = size // 2
+    pad_r = size - 1 - pad_l
+    out = x
+    for axis in (0, 1):
+        xs = np.swapaxes(out, 0, axis)
+        padded = np.pad(xs, ((pad_l, pad_r), (0, 0)), mode="edge")
+        c = np.cumsum(padded, axis=0, dtype=np.float64)
+        c = np.vstack([np.zeros((1, c.shape[1])), c])
+        xs = (c[size:] - c[:-size]) / size
+        out = np.swapaxes(xs, 0, axis)
+    return out
+
+
+def ssim(ref: np.ndarray, dist: np.ndarray, peak: float = 255.0,
+         window: int = 8) -> float:
+    """Mean structural similarity (Wang et al. 2004, uniform window —
+    the same simplification x264's ssim tuning uses)."""
+    ref = ref.astype(np.float64)
+    dist = dist.astype(np.float64)
+    c1 = (0.01 * peak) ** 2
+    c2 = (0.03 * peak) ** 2
+    mu_x = _uniform_filter(ref, window)
+    mu_y = _uniform_filter(dist, window)
+    sxx = _uniform_filter(ref * ref, window) - mu_x * mu_x
+    syy = _uniform_filter(dist * dist, window) - mu_y * mu_y
+    sxy = _uniform_filter(ref * dist, window) - mu_x * mu_y
+    num = (2 * mu_x * mu_y + c1) * (2 * sxy + c2)
+    den = (mu_x ** 2 + mu_y ** 2 + c1) * (sxx + syy + c2)
+    return float(np.mean(num / den))
+
+
+def clip_quality(ref_frames, dist_y_planes) -> dict[str, float]:
+    """Mean luma PSNR/SSIM of a decoded clip vs its source frames.
+
+    ref_frames: list of core.types.Frame; dist_y_planes: decoded luma
+    planes (same count/geometry — the caller crops any codec padding).
+    """
+    n = min(len(ref_frames), len(dist_y_planes))
+    ps, ss = [], []
+    for i in range(n):
+        ry = ref_frames[i].y
+        dy = dist_y_planes[i][:ry.shape[0], :ry.shape[1]]
+        ps.append(psnr(ry, dy))
+        ss.append(ssim(ry, dy))
+    finite = [p for p in ps if np.isfinite(p)]
+    return {
+        "psnr_y": float(np.mean(finite)) if finite else float("inf"),
+        "ssim_y": float(np.mean(ss)) if ss else 1.0,
+        "frames_compared": n,
+    }
