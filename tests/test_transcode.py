@@ -1,0 +1,145 @@
+"""Real transcoding: MP4 demux → libavcodec decode → re-encode, with
+audio passthrough. The reference's core competency — transcoding
+compressed sources, not just raw ingest
+(/root/reference/worker/tasks.py:1354-1737) — exercised natively.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from thinvids_tpu.core.types import Frame, VideoMeta
+from thinvids_tpu.ingest.decode import DecodeError, read_video
+from thinvids_tpu.ingest.probe import probe_video
+from thinvids_tpu.io.mp4 import Mp4Track, demux_mp4, mux_mp4, read_mp4
+from thinvids_tpu.parallel.dispatch import encode_clip_sharded
+from thinvids_tpu.tools import oracle
+
+
+def _clip(n=8, w=64, h=48):
+    yy, xx = np.mgrid[0:h, 0:w]
+    return [Frame(((xx * 2 + 3 * i) % 256).astype(np.uint8),
+                  np.full((h // 2, w // 2), 100, np.uint8),
+                  np.full((h // 2, w // 2), 150, np.uint8))
+            for i in range(n)], VideoMeta(width=w, height=h, fps_num=30,
+                                          fps_den=1, num_frames=n)
+
+
+def _fake_audio(n_samples=6):
+    # a structurally valid mp4a sample entry (we never decode it)
+    entry = (b"\x00\x00\x00\x24mp4a" + b"\x00" * 6 + b"\x00\x01"
+             + b"\x00" * 8 + b"\x00\x02\x00\x10" + b"\x00" * 4
+             + b"\xbb\x80\x00\x00")
+    return Mp4Track(handler="soun", stsd_entry=entry, timescale=48000,
+                    stts=[(n_samples, 1024)],
+                    samples=[bytes([40 + i]) * 32 for i in range(n_samples)])
+
+
+class TestDemux:
+    def test_roundtrip_own_output(self):
+        frames, meta = _clip()
+        stream = encode_clip_sharded(frames, meta, qp=27, gop_frames=4)
+        m = demux_mp4(mux_mp4(stream, meta))
+        assert (m.width, m.height) == (meta.width, meta.height)
+        assert m.num_frames == len(frames)
+        assert m.fps == (90000, 3000)           # 30 fps
+        assert m.keyflags[0] is True
+        # Slice NALs are bit-exact vs the original stream (SPS/PPS are
+        # hoisted into avcC once; the source repeats them per GOP head)
+        from thinvids_tpu.io.mp4 import split_annexb
+
+        slices = lambda s: [n for n in split_annexb(s)
+                            if n[0] & 0x1F in (1, 5)]
+        assert slices(m.annexb) == slices(stream)
+
+    def test_audio_track_roundtrip(self):
+        frames, meta = _clip()
+        stream = encode_clip_sharded(frames, meta, qp=27, gop_frames=4)
+        audio = _fake_audio()
+        m = demux_mp4(mux_mp4(stream, meta, audio=audio))
+        assert m.audio is not None
+        assert m.audio.samples == audio.samples
+        assert m.audio.stts == audio.stts
+        assert m.audio.timescale == audio.timescale
+        assert m.audio.stsd_entry == audio.stsd_entry
+
+    def test_non_avc_video_rejected(self):
+        with pytest.raises(ValueError):
+            demux_mp4(b"\x00\x00\x00\x08free")
+
+
+class TestProbeMp4:
+    def test_probe_matches_content(self, tmp_path):
+        frames, meta = _clip(n=12)
+        stream = encode_clip_sharded(frames, meta, qp=27, gop_frames=4)
+        p = tmp_path / "a.mp4"
+        p.write_bytes(mux_mp4(stream, meta))
+        got = probe_video(str(p))
+        assert (got.width, got.height) == (64, 48)
+        assert got.num_frames == 12
+        assert got.codec == "h264"
+        assert abs(got.duration_s - 0.4) < 1e-6
+
+
+@pytest.mark.skipif(not oracle.oracle_available(),
+                    reason="libavcodec missing")
+class TestReadVideo:
+    def test_mp4_decodes_to_frames(self, tmp_path):
+        frames, meta = _clip()
+        stream = encode_clip_sharded(frames, meta, qp=27, gop_frames=4)
+        p = tmp_path / "in.mp4"
+        p.write_bytes(mux_mp4(stream, meta, audio=_fake_audio()))
+        got_meta, got_frames, audio = read_video(str(p))
+        assert got_meta.num_frames == len(frames)
+        assert got_frames[0].y.shape == frames[0].y.shape
+        assert audio is not None and len(audio.samples) == 6
+        # decoded content matches what our own decoder would produce
+        # (same libavcodec path the conformance tests trust): just
+        # check it's close to the source at qp 27
+        err = np.abs(got_frames[3].y.astype(int)
+                     - frames[3].y.astype(int)).mean()
+        assert err < 12.0
+
+    def test_unsupported_ext(self, tmp_path):
+        p = tmp_path / "x.mkv"
+        p.write_bytes(b"")
+        with pytest.raises(DecodeError):
+            read_video(str(p))
+
+    def test_mp4_to_mp4_transcode_via_executor(self, tmp_path):
+        from thinvids_tpu.cluster.coordinator import Coordinator
+        from thinvids_tpu.cluster.executor import LocalExecutor
+        from thinvids_tpu.core.config import (
+            reset_live_settings,
+            update_live_settings,
+        )
+        from thinvids_tpu.core.status import Status
+
+        reset_live_settings()
+        try:
+            frames, meta = _clip(n=8)
+            stream = encode_clip_sharded(frames, meta, qp=24,
+                                         gop_frames=4)
+            src = tmp_path / "movie.mp4"
+            src.write_bytes(mux_mp4(stream, meta, audio=_fake_audio()))
+
+            co = Coordinator()
+            for i in range(4):
+                co.registry.heartbeat(f"w{i}")
+            update_live_settings({"pipeline_worker_count": 4,
+                                  "min_idle_workers": 0,
+                                  "gop_frames": 4})
+            execu = LocalExecutor(co, str(tmp_path / "out"), sync=True)
+            co._launcher = execu.launch
+            job = co.add_job(str(src), meta=probe_video(str(src)),
+                             auto_start=True)
+            job = co.store.get(job.id)
+            assert job.status is Status.DONE, job.failure_reason
+            out = read_mp4(job.output_path)
+            assert out.num_frames == 8
+            # audio rode through bit-exact
+            assert out.audio is not None
+            assert out.audio.samples == _fake_audio().samples
+        finally:
+            reset_live_settings()

@@ -24,8 +24,8 @@ from collections import deque
 from typing import Callable
 
 from ..core.status import Status
+from ..ingest.decode import read_video
 from ..io.mp4 import mux_mp4
-from ..io.y4m import read_y4m
 from ..core.types import concat_segments
 from .coordinator import Coordinator
 from .jobs import Job
@@ -87,7 +87,7 @@ class LocalExecutor:
         try:
             settings = co.job_settings(job)
             co.heartbeat_job(job.id, token, stage, host=self.host)
-            meta, frames = read_y4m(job.input_path)
+            meta, frames, audio = read_video(job.input_path)
             if not frames:
                 raise ValueError(f"no frames in {job.input_path}")
             if not co.mark_running(job.id, token):
@@ -117,7 +117,7 @@ class LocalExecutor:
             base = os.path.splitext(os.path.basename(job.input_path))[0]
             out_path = os.path.join(self.output_dir, base + ".mp4")
             os.makedirs(self.output_dir, exist_ok=True)
-            data = mux_mp4(stream, meta)
+            data = mux_mp4(stream, meta, audio=audio)
             tmp = f"{out_path}.{job.id}.tmp"    # job-unique: no clobber
                                                 # across same-name jobs
             with open(tmp, "wb") as fp:

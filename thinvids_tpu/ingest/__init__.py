@@ -6,8 +6,10 @@ appear under a watch root are size-stabilized, checked against a
 durable processed ledger, probed, and submitted as jobs.
 """
 
-from .probe import probe_video
+from .decode import DecodeError, read_video, supported_exts
+from .probe import ProbeError, probe_video
 from .watcher import FileLedger, WatchIngester, coordinator_submitter
 
-__all__ = ["probe_video", "FileLedger", "WatchIngester",
+__all__ = ["DecodeError", "ProbeError", "probe_video", "read_video",
+           "supported_exts", "FileLedger", "WatchIngester",
            "coordinator_submitter"]

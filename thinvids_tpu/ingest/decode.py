@@ -1,0 +1,84 @@
+"""Input decode: turn a media file into YUV frames for the encode mesh.
+
+The reference transcoded arbitrary compressed sources by delegating
+decode to ffmpeg inside each worker's encode command
+(/root/reference/worker/tasks.py:1354-1737); here decode is an ingest
+stage: raw .y4m reads directly, .mp4 (AVC) demuxes natively
+(io/mp4.demux_mp4) and decodes through the bound libavcodec
+(tools/oracle) into Frame planes — the same decoder the conformance
+tests trust. The source's audio track rides along for bit-exact
+passthrough into the transcoded output.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..core.types import Frame, VideoMeta
+from ..io.mp4 import Mp4Track
+
+
+class DecodeError(ValueError):
+    """File cannot be decoded into frames."""
+
+
+def _read_y4m(path: str):
+    from ..io.y4m import read_y4m
+
+    meta, frames = read_y4m(path)
+    return meta, frames, None
+
+
+def _read_mp4(path: str):
+    from ..io.mp4 import read_mp4
+    from ..tools import oracle
+
+    if not oracle.oracle_available():
+        raise DecodeError(
+            "mp4 input needs the libavcodec decoder, which is "
+            "unavailable in this environment")
+    m = read_mp4(path)
+    planes = oracle.decode_h264(m.annexb)
+    if len(planes) != m.num_frames:
+        raise DecodeError(
+            f"decoded {len(planes)} frames, container says "
+            f"{m.num_frames}")
+    w, h = m.width, m.height
+    frames = [Frame(y=y[:h, :w], u=u[:h // 2, :w // 2],
+                    v=v[:h // 2, :w // 2]) for (y, u, v) in planes]
+    num, den = m.fps
+    meta = VideoMeta(width=w, height=h, fps_num=num, fps_den=den,
+                     num_frames=len(frames), codec="h264",
+                     duration_s=m.duration_ts / max(1, m.timescale),
+                     size_bytes=os.path.getsize(path))
+    return meta, frames, m.audio
+
+
+_READERS = {
+    ".y4m": _read_y4m,
+    ".mp4": _read_mp4,
+}
+
+
+def read_video(path: str | os.PathLike
+               ) -> tuple[VideoMeta, list[Frame], Mp4Track | None]:
+    """(meta, frames, audio_track_or_None) for a supported input.
+
+    Raises :class:`DecodeError` for unsupported extensions or undecodable
+    content. Supported extensions: `supported_exts()`.
+    """
+    path = os.fspath(path)
+    ext = os.path.splitext(path)[1].lower()
+    reader = _READERS.get(ext)
+    if reader is None:
+        raise DecodeError(f"unsupported media extension {ext!r}: {path}")
+    try:
+        return reader(path)
+    except DecodeError:
+        raise
+    except (OSError, ValueError, EOFError) as exc:
+        raise DecodeError(f"cannot decode {path}: {exc}") from exc
+
+
+def supported_exts() -> tuple[str, ...]:
+    return tuple(_READERS)

@@ -42,8 +42,21 @@ def _probe_y4m(path: str) -> VideoMeta:
     )
 
 
+def _probe_mp4(path: str) -> VideoMeta:
+    from ..io.mp4 import probe_mp4_header
+
+    info = probe_mp4_header(path)       # moov-only: never loads mdat
+    return VideoMeta(
+        width=info["width"], height=info["height"],
+        fps_num=info["fps_num"], fps_den=info["fps_den"],
+        num_frames=info["num_frames"], codec=info["codec"],
+        duration_s=info["duration_s"],
+        size_bytes=os.path.getsize(path))
+
+
 _PROBERS = {
     ".y4m": _probe_y4m,
+    ".mp4": _probe_mp4,
 }
 
 

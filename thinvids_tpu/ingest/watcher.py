@@ -26,6 +26,8 @@ import os
 import threading
 from typing import Callable, Iterable
 
+from .decode import supported_exts as decode_supported_exts
+
 try:
     import fcntl
 except ImportError:                      # non-POSIX: best-effort locking
@@ -147,10 +149,11 @@ class WatchIngester:
     return marks the file processed in the ledger.
     """
 
-    # Only extensions probe_video can actually ingest: submitting a
-    # file the probe rejects would never mark the ledger and retry
-    # forever. Widen in lockstep with ingest/probe.py.
-    DEFAULT_EXTS = (".y4m",)
+    # Watch exactly what the decode stage can ingest — submitting a
+    # file the probe/decoder rejects would never mark the ledger and
+    # retry forever. Derived, not hand-synced: widening decode._READERS
+    # widens the watch set automatically.
+    DEFAULT_EXTS = decode_supported_exts()
 
     def __init__(self, watch_dir: str, ledger: FileLedger,
                  submit: Callable[[str], bool],
