@@ -1,0 +1,128 @@
+"""Node agent: metrics sampling, heartbeat sinks, idle-suspend gate.
+
+Mirrors the reference agent's contracts (/root/reference/agent/agent.py:
+355-496): 1 Hz metrics → registry (TTL = liveness), suspend only after
+cpu + cluster-idle gates hold for suspend_idle_s, one suspend per idle
+episode.
+"""
+
+import pytest
+
+from thinvids_tpu.cluster.agent import (
+    NodeAgent,
+    coordinator_submitter,
+    http_submitter,
+    sample_device_metrics,
+    sample_host_metrics,
+)
+from thinvids_tpu.cluster.coordinator import Coordinator
+from thinvids_tpu.core.config import (
+    get_settings,
+    reset_live_settings,
+    update_live_settings,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_settings():
+    reset_live_settings()
+    yield
+    reset_live_settings()
+
+
+class TestSampling:
+    def test_host_metrics_fields(self):
+        m = sample_host_metrics()
+        assert 0.0 <= m["cpu"] <= 100.0
+        assert 0.0 <= m["mem"] <= 100.0
+        assert m["mem_total"] > 0
+        assert "net_rx_bytes" in m and "disk" in m
+
+    def test_device_metrics_graceful(self):
+        m = sample_device_metrics()
+        assert m["devices"] >= 1          # CPU backend still reports
+        if "hbm_pct" in m:
+            assert 0.0 <= m["hbm_pct"] <= 100.0
+
+
+class TestHeartbeatSinks:
+    def test_coordinator_submitter_feeds_registry(self):
+        co = Coordinator()
+        agent = NodeAgent(coordinator_submitter(co), host="n1",
+                          clock=lambda: 1000.0)
+        m = agent.tick()
+        workers = {w.host: w for w in co.registry.all()}
+        assert "n1" in workers
+        assert workers["n1"].metrics["cpu"] == m["cpu"]
+        assert workers["n1"].metrics["role"] == "encode"
+
+    def test_http_submitter_roundtrip(self):
+        from thinvids_tpu.api import ApiServer
+
+        co = Coordinator()
+        server = ApiServer(co).start()
+        try:
+            agent = NodeAgent(http_submitter(server.url), host="remote1")
+            agent.tick()
+            workers = {w.host for w in co.registry.all()}
+            assert "remote1" in workers
+        finally:
+            server.stop()
+
+    def test_submit_failure_does_not_crash_tick(self):
+        def bad(host, metrics):
+            raise OSError("network down")
+        agent = NodeAgent(bad, host="n2")
+        agent.tick()                      # must not raise
+
+
+class TestIdleGate:
+    def _agent(self, clock, idle, suspended):
+        update_live_settings({"suspend_enabled": True,
+                              "suspend_idle_s": 300.0,
+                              "suspend_cpu_pct": 200.0})  # cpu gate open
+        return NodeAgent(lambda h, m: None, host="n3",
+                         settings_fn=get_settings,
+                         idle_probe=lambda: idle["v"],
+                         suspend_action=lambda: suspended.append(1),
+                         clock=lambda: clock["t"])
+
+    def test_suspend_after_idle_window_once(self):
+        clock, idle, susp = {"t": 0.0}, {"v": True}, []
+        agent = self._agent(clock, idle, susp)
+        agent.tick()                      # idle episode starts
+        clock["t"] = 299.0
+        agent.tick()
+        assert susp == []                 # window not yet elapsed
+        clock["t"] = 301.0
+        agent.tick()
+        assert susp == [1]
+        clock["t"] = 500.0
+        agent.tick()
+        assert susp == [1]                # once per episode
+
+    def test_activity_resets_idle_window(self):
+        clock, idle, susp = {"t": 0.0}, {"v": True}, []
+        agent = self._agent(clock, idle, susp)
+        agent.tick()
+        clock["t"] = 200.0
+        idle["v"] = False                 # a job arrived
+        agent.tick()
+        idle["v"] = True
+        clock["t"] = 450.0                # 250 s since re-idle: not yet
+        agent.tick()
+        clock["t"] = 460.0
+        agent.tick()
+        assert susp == []
+        clock["t"] = 751.0
+        agent.tick()
+        assert susp == [1]
+
+    def test_disabled_never_suspends(self):
+        clock, idle, susp = {"t": 0.0}, {"v": True}, []
+        agent = self._agent(clock, idle, susp)
+        update_live_settings({"suspend_enabled": False})
+        for t in (0.0, 400.0, 800.0):
+            clock["t"] = t
+            agent.tick()
+        assert susp == []

@@ -166,6 +166,7 @@ class ApiServer:
         ("GET", r"^/activity$", "activity"),
         ("GET", r"^/job_activity/(?P<job_id>[\w-]+)$", "job_activity"),
         ("GET", r"^/nodes_data$", "nodes_data"),
+        ("POST", r"^/node_heartbeat$", "node_heartbeat"),
         ("POST", r"^/nodes/disable/(?P<host>[\w.-]+)$", "node_disable"),
         ("POST", r"^/nodes/enable/(?P<host>[\w.-]+)$", "node_enable"),
         ("DELETE", r"^/nodes/delete/(?P<host>[\w.-]+)$", "node_delete"),
@@ -322,6 +323,19 @@ class ApiServer:
             })
         nodes.sort(key=lambda n: n["host"])
         return 200, {"nodes": nodes}
+
+    def _h_node_heartbeat(self, query, body) -> tuple[int, Any]:
+        """Cross-host agent heartbeat sink (the reference's
+        `HSET metrics:node:<host>` + EXPIRE, agent.py:417-436 — here
+        the registry's TTL provides the liveness window)."""
+        host = str(body.get("host", "")).strip()
+        if not host:
+            raise ApiError(400, "host required")
+        metrics = body.get("metrics") or {}
+        if not isinstance(metrics, dict):
+            raise ApiError(400, "metrics must be an object")
+        self.coordinator.registry.heartbeat(host, metrics=metrics)
+        return 200, {"ok": True}
 
     def _h_node_disable(self, query, body, host) -> tuple[int, Any]:
         self.coordinator.registry.set_disabled(
