@@ -58,6 +58,21 @@ def api(tmp_path):
     reset_live_settings()
 
 
+class TestUi:
+    def test_dashboard_served_at_root(self, api):
+        server, co, execu, tmp_path = api
+        req = urllib.request.Request(server.url + "/")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/html")
+            page = resp.read().decode()
+        # the page drives the same JSON routes the tests do
+        for route in ("/jobs", "/add_job", "/nodes_data",
+                      "/metrics_snapshot", "/activity", "/settings"):
+            assert route in page
+        assert "thinvids" in page
+
+
 class TestLifecycle:
     def test_full_job_lifecycle_over_http(self, api):
         server, co, execu, tmp_path = api

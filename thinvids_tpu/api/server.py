@@ -97,10 +97,22 @@ class ApiServer:
                     raise ApiError(400, "JSON body must be an object")
                 return data
 
+            def _reply_html(self, content: bytes) -> None:
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(content)))
+                self.end_headers()
+                self.wfile.write(content)
+
             def _dispatch(self, method: str) -> None:
                 url = urlparse(self.path)
                 query = {k: v[-1] for k, v in parse_qs(url.query).items()}
                 try:
+                    if method == "GET" and url.path in ("/", "/ui"):
+                        from .. import ui
+
+                        self._reply_html(ui.index_html())
+                        return
                     body = self._body() if method in ("POST", "PUT") else {}
                     status, payload = api.route(method, url.path, query,
                                                 body)
