@@ -1,0 +1,126 @@
+"""CLI daemons: the real deployment entrypoints, driven as processes.
+
+The coordinator process = API + executor + ingest + durable state; the
+agent process heartbeats over HTTP. These are the units deploy/*.service
+run (reference analog: ansible units, SURVEY §2.8).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from thinvids_tpu.core.types import Frame, VideoMeta
+from thinvids_tpu.io.y4m import write_y4m
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _call(base, path, method="GET", body=None, timeout=5):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(base + path, data=data, method=method)
+    if data:
+        req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _wait_api(base, deadline_s=30):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        try:
+            return _call(base, "/health")
+        except (urllib.error.URLError, ConnectionError, OSError):
+            time.sleep(0.3)
+    raise TimeoutError(f"coordinator API never came up at {base}")
+
+
+def _spawn_coordinator(tmp_path, port):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               TVT_MIN_IDLE_WORKERS="0", TVT_PIPELINE_WORKER_COUNT="2")
+    return subprocess.Popen(
+        [sys.executable, "-m", "thinvids_tpu.cli", "coordinator",
+         "--host", "127.0.0.1", "--port", str(port),
+         "--state-dir", str(tmp_path / "state"),
+         "--watch-dir", str(tmp_path / "watch"),
+         "--output-dir", str(tmp_path / "library"),
+         "--scan-interval", "0.5"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+def test_coordinator_process_end_to_end(tmp_path):
+    os.makedirs(tmp_path / "watch")
+    import socket
+    with socket.socket() as sk:          # reserve a free port
+        sk.bind(("127.0.0.1", 0))
+        port = sk.getsockname()[1]
+    base = f"http://127.0.0.1:{port}"
+    proc = _spawn_coordinator(tmp_path, port)
+    try:
+        _wait_api(base)
+        # dashboard serves
+        with urllib.request.urlopen(base + "/", timeout=5) as resp:
+            assert resp.headers["Content-Type"].startswith("text/html")
+
+        # agent process heartbeats in
+        agent = subprocess.Popen(
+            [sys.executable, "-m", "thinvids_tpu.cli", "agent",
+             "--coordinator", base, "--node-name", "w-test",
+             "--interval", "0.3"],
+            env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        try:
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                nodes = _call(base, "/nodes_data")["nodes"]
+                if any(n["host"] == "w-test" for n in nodes):
+                    break
+                time.sleep(0.3)
+            assert any(n["host"] == "w-test" for n in nodes)
+        finally:
+            agent.send_signal(signal.SIGINT)
+            agent.wait(timeout=10)
+
+        # watch-folder ingest → transcode → DONE
+        n, w, h = 6, 48, 32
+        frames = [Frame(np.full((h, w), 60 + 20 * i, np.uint8),
+                        np.full((h // 2, w // 2), 110, np.uint8),
+                        np.full((h // 2, w // 2), 140, np.uint8))
+                  for i in range(n)]
+        meta = VideoMeta(width=w, height=h, fps_num=30, fps_den=1,
+                         num_frames=n)
+        write_y4m(str(tmp_path / "watch" / "clip.y4m"), meta, frames)
+        deadline = time.time() + 120
+        job = None
+        while time.time() < deadline:
+            jobs = _call(base, "/jobs")["jobs"]
+            if jobs and jobs[0]["status"] in ("done", "failed"):
+                job = jobs[0]
+                break
+            time.sleep(0.5)
+        assert job is not None and job["status"] == "done", job
+        assert os.path.exists(job["output_path"])
+
+        # hard-kill and restart over the same state dir: the DONE job
+        # must be recovered from the journal
+        proc.kill()
+        proc.wait(timeout=10)
+        proc = _spawn_coordinator(tmp_path, port)
+        _wait_api(base)
+        jobs = _call(base, "/jobs")["jobs"]
+        assert len(jobs) == 1 and jobs[0]["status"] == "done"
+        # the watcher ledger survived too: no double-submit
+        time.sleep(1.5)
+        assert len(_call(base, "/jobs")["jobs"]) == 1
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()

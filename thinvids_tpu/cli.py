@@ -1,0 +1,155 @@
+"""Process entrypoints: coordinator and agent daemons.
+
+`python -m thinvids_tpu.cli coordinator` is the manager-host process —
+the union of the reference's gunicorn app + watcher daemon +
+housekeeping unit (/root/reference/ansible_manager.yml:264-349):
+durable coordinator, executor, HTTP API + dashboard, watch-folder
+ingest, orphan recovery, scheduler kicks.
+
+`python -m thinvids_tpu.cli agent` is the worker-host daemon — the
+reference's thinman-agent (/root/reference/agent/agent.py): 1 Hz
+host + accelerator metrics heartbeats to the coordinator API.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import threading
+
+
+def run_coordinator(args: argparse.Namespace) -> None:
+    from .api import ApiServer
+    from .cluster.agent import NodeAgent, coordinator_submitter
+    from .cluster.coordinator import Coordinator
+    from .cluster.executor import LocalExecutor
+    from .core.log import get_logging
+    from .ingest import FileLedger, WatchIngester, coordinator_submitter \
+        as ingest_submitter
+
+    log = get_logging("thinvids_tpu.coordinator")
+    state_dir = args.state_dir or os.environ.get("TVT_STATE_DIR")
+    co = Coordinator(state_dir=state_dir)
+    execu = LocalExecutor(co, args.output_dir, sync=False)
+    co._launcher = execu.launch
+    requeued = co.recover_jobs()
+    if requeued:
+        log.info("requeued %d orphaned jobs after restart", len(requeued))
+    # scheduler poll + watchdog (the reference's daemon threads,
+    # app.py:1474-1516) — without these a WAITING job whose dispatch
+    # gate failed once would sit queued forever
+    co.start_background()
+
+    api = ApiServer(co, host=args.host, port=args.port).start()
+    log.info("api + dashboard on %s", api.url)
+
+    # Local agent: the coordinator host reports its own health, and its
+    # accelerator devices register as encode slots — on a TPU host the
+    # devices are the "workers" the scheduler gates on (the reference
+    # gated on live thin-client nodes, app.py:1088-1133).
+    host_submit = coordinator_submitter(co)
+
+    def submit(host: str, metrics) -> None:
+        host_submit(host, metrics)
+        for i in range(int(metrics.get("devices", 0) or 0)):
+            co.registry.heartbeat(f"{host}-dev{i}")
+
+    agent = NodeAgent(submit, idle_probe=co.store.all_idle).start()
+
+    stop = threading.Event()
+    watcher_thread = None
+    if args.watch_dir:
+        ledger = FileLedger(os.path.join(
+            state_dir or args.output_dir, "processed.log"))
+        ingester = WatchIngester(args.watch_dir, ledger,
+                                 submit=ingest_submitter(co))
+        adopted = ingester.bootstrap_if_first_run()
+        if adopted:
+            log.info("first run: adopted %d existing files", adopted)
+
+        def watch_loop() -> None:
+            while not stop.wait(args.scan_interval):
+                try:
+                    for rel in ingester.scan_once():
+                        log.info("ingested %s", rel)
+                except Exception as exc:     # noqa: BLE001 - keep watching
+                    log.warning("watch scan failed: %s", exc)
+
+        watcher_thread = threading.Thread(target=watch_loop, daemon=True,
+                                          name="tvt-watcher")
+        watcher_thread.start()
+        log.info("watching %s", args.watch_dir)
+
+    def shutdown(*_sig) -> None:
+        stop.set()
+        co.stop_background()
+        agent.stop()
+        api.stop()
+        # let in-flight encodes finish before the journal closes — a
+        # SIGTERM mid-job must not behave like a crash
+        execu.join(timeout=30)
+        co.close()
+
+    signal.signal(signal.SIGTERM, shutdown)
+    try:
+        signal.pause()
+    except (KeyboardInterrupt, AttributeError):
+        pass
+    finally:
+        shutdown()
+
+
+def run_agent(args: argparse.Namespace) -> None:
+    from .cluster.agent import NodeAgent, http_submitter
+    from .core.log import get_logging
+
+    log = get_logging("thinvids_tpu.agent")
+    agent = NodeAgent(http_submitter(args.coordinator), host=args.node_name,
+                      interval_s=args.interval)
+    log.info("heartbeating to %s every %.1fs", args.coordinator,
+             args.interval)
+    agent.start()
+    try:
+        signal.pause()
+    except (KeyboardInterrupt, AttributeError):
+        pass
+    finally:
+        agent.stop()
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="thinvids_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    c = sub.add_parser("coordinator", help="manager: API, scheduler, "
+                                           "executor, ingest")
+    c.add_argument("--host", default="0.0.0.0")
+    c.add_argument("--port", type=int,
+                   default=int(os.environ.get("TVT_API_PORT", "5005")))
+    c.add_argument("--state-dir",
+                   default=os.environ.get("TVT_STATE_DIR"))
+    c.add_argument("--watch-dir",
+                   default=os.environ.get("TVT_WATCH_DIR"))
+    c.add_argument("--output-dir",
+                   default=os.environ.get("TVT_OUTPUT_DIR", "./library"))
+    c.add_argument("--scan-interval", type=float, default=60.0)
+    c.set_defaults(fn=run_coordinator)
+
+    a = sub.add_parser("agent", help="worker: metrics heartbeats")
+    a.add_argument("--coordinator",
+                   default=os.environ.get("TVT_COORDINATOR_URL",
+                                          "http://127.0.0.1:5005"))
+    a.add_argument("--node-name", default=None)
+    a.add_argument("--interval", type=float, default=1.0)
+    a.set_defaults(fn=run_agent)
+    return p
+
+
+def main(argv: list[str] | None = None) -> None:
+    args = build_parser().parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

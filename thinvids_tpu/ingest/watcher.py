@@ -252,14 +252,25 @@ class WatchIngester:
 def coordinator_submitter(coordinator, activity_host: str = "watcher"):
     """submit() implementation targeting an in-process Coordinator:
     probe → add_job (the reference POSTed to /add_job,
-    watcher.py:415-428). Unprobeable files are skipped (False)."""
+    watcher.py:415-428). Unprobeable files are recorded in the activity
+    feed and MARKED processed (True) — the reference likewise ledgered
+    files whose /add_job came back REJECTED; returning False would
+    retry a corrupt file on every scan forever."""
     from .probe import ProbeError, probe_video
 
     def submit(abs_path: str) -> bool:
         try:
             meta = probe_video(abs_path)
-        except ProbeError:
-            return False
+        except ProbeError as exc:
+            if isinstance(exc.__cause__, OSError):
+                # transient I/O (NFS hiccup, EACCES-until-chmod): retry
+                # on a later scan — ledgering now would blacklist the
+                # file forever since its signature won't change
+                return False
+            coordinator.activity.emit(
+                "reject", f"unprobeable, skipped: {exc}",
+                host=activity_host)
+            return True
         job = coordinator.add_job(abs_path, meta)
         return job is not None
 
