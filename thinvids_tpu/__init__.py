@@ -4,25 +4,45 @@ A ground-up rebuild of the capabilities of AwsGeek/thinvids (a Redis/Huey/
 ffmpeg/VAAPI thin-client transcoding farm) designed TPU-first:
 
 - the encode path is jitted JAX compute (integer transforms, quantization,
-  intra prediction, block motion estimation) over HBM-resident YUV planes
-  plus a native C++ CAVLC entropy packer, instead of external ffmpeg+VAAPI
-  processes;
+  intra prediction, fused motion search + compensation) over HBM-resident
+  YUV planes plus a native C++ CAVLC entropy packer, instead of external
+  ffmpeg+VAAPI processes;
 - segment/GOP parallelism uses ``jax.sharding.Mesh`` + ``shard_map``
-  (one closed GOP per device per wave) instead of Huey task dispatch to
-  worker nodes;
-- the control plane (job store, scheduler, watchdog, heartbeats, activity
-  log, executor) is an in-process coordinator whose semantics port the
-  reference's manager (reference: /root/reference/manager/app.py).
+  (closed GOPs fanned over devices per wave, two-tier sparse level
+  transfer back to host) instead of Huey task dispatch to worker nodes;
+- rate control is collective: per-GOP complexity stats are exchanged with
+  ``jax.lax.psum`` over the mesh inside the sharded program, feeding a
+  two-pass VBR QP solve (parallel/rc.py);
+- the control plane (durable journal-backed job store, scheduler,
+  watchdog, heartbeats, activity log, executor with per-wave retry) is a
+  coordinator whose semantics port the reference's manager, fronted by a
+  stdlib HTTP JSON API + single-page dashboard.
 
 Layout:
     core/      video types, layered config, status/events, logging, devices
     codecs/    H.264 intra+inter encode (JAX compute, bit-exact vs
                libavcodec) + CAVLC entropy coding
-    parallel/  segment planner, mesh helpers, shard_map GOP dispatch
-    cluster/   coordinator, job store, admission policy, executor
-    io/        y4m reader, bit writer, MP4 muxer
-    tools/     libavcodec ctypes oracle (conformance decode)
+    parallel/  segment planner, mesh helpers, shard_map GOP dispatch,
+               psum rate control
+    cluster/   coordinator, durable job store, admission policy, executor,
+               node agent (host + HBM metrics)
+    ingest/    watch-folder discovery + processed ledger, native probe,
+               input decode (.y4m, .mp4/AVC via bound libavcodec)
+    io/        y4m reader/writer, bit writer, MP4 muxer/demuxer with
+               audio-track passthrough
+    api/       HTTP JSON API over the coordinator (reference route set)
+    ui/        static dashboard page served at / by the API
+    tools/     libavcodec ctypes oracle, PSNR/SSIM metrics, stamp/seam
+               watermark harness
     native/    C++ hot paths (CAVLC entropy packing) loaded via ctypes
+    cli.py     coordinator + agent daemon entrypoints (deploy/*.service)
+
+Known deviation: H.264 in-loop deblocking stays disabled in the emitted
+bitstreams (PPS/slice flags). The spec's filter order is an MB-raster
+wavefront — each MB's vertical edges read the horizontally-filtered
+output of its left neighbor — which is inherently sequential at MB
+granularity and maps poorly onto XLA's whole-array execution model;
+output quality is instead tracked via the PSNR/SSIM bench line.
 """
 
-__version__ = "0.2.0"
+__version__ = "0.4.0"
