@@ -121,7 +121,9 @@ def main() -> None:
     platform = jax.devices()[0].platform
     qp, gop = 27, 8
 
-    n_1080 = 48
+    # 64 frames = 8 GOPs = two full 4-GOP waves: every timed wave runs
+    # the same compiled shape (no tail-wave recompile skew).
+    n_1080 = 64
     fps, dev_fps, nbytes, quality = _run_pipeline(1920, 1080, n_1080, qp,
                                                   gop)
 

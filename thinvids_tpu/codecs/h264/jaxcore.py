@@ -396,9 +396,11 @@ def block_sparse_fits(nblk: int, n_esc: int, L: int,
 
 
 # Value-stream budget for the two-tier pack: elementwise nonzero density
-# beyond 1/div falls back dense. Measured 1080p GOP at qp 27: ~723K
-# nonzero coeffs of 25.5M (~3%); 1/16 leaves 2x headroom.
-_VAL_BUDGET_DIV = 16
+# beyond 1/div falls back dense. Measured 1080p GOP at qp 27 on heavily
+# grainy content: ~723K nonzero coeffs of 25.5M (~2.8%); 1/24 still
+# leaves ~1.5x headroom, and every budget byte rides the ~8 MB/s
+# device->host link once per GOP.
+_VAL_BUDGET_DIV = 24
 
 
 def _block_sparse_pack2(flat, budget_div: int = _BLOCK_BUDGET_DIV,
