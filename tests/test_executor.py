@@ -149,6 +149,90 @@ class TestEndToEnd:
         assert "1 retries" in job.failure_reason
         assert "device on fire" in job.failure_reason
 
+    def test_elastic_replan_on_shrunken_mesh(self, tmp_path, clip_y4m):
+        """A wave that keeps failing on the full mesh exhausts its
+        budget; the executor re-plans the remaining frames on a smaller
+        mesh and the job still completes with every frame (SURVEY §2.9
+        Elastic DP)."""
+        from thinvids_tpu.tools import oracle
+
+        mesh_sizes = []
+
+        class DyingMeshEncoder:
+            """Collect always fails while the mesh has 8 devices."""
+
+            def __init__(self, meta, settings, mesh):
+                self.inner = LocalExecutor._default_encoder(
+                    meta, settings, mesh)
+                mesh_sizes.append(self.inner.num_devices)
+
+            def __getattr__(self, name):      # mesh/meta/offsets delegate
+                return getattr(self.inner, name)
+
+            def __setattr__(self, name, value):
+                if name == "inner":
+                    object.__setattr__(self, name, value)
+                else:
+                    setattr(self.inner, name, value)
+
+            def collect_wave(self, pending):
+                if self.inner.num_devices == 8:
+                    raise RuntimeError("slice lost a chip")
+                return self.inner.collect_wave(pending)
+
+        snap = make_settings(gop_frames=4, qp=30,
+                             part_failure_max_retries=1,
+                             heartbeat_throttle_s=0.0)
+        coord, _ = make_rig(
+            tmp_path, settings=snap,
+            encoder_factory=lambda m, s, mesh: DyingMeshEncoder(m, s, mesh))
+        job = coord.add_job(clip_y4m, VideoMeta(width=64, height=48,
+                                                num_frames=12))
+        job = coord.store.get(job.id)
+        assert job.status is Status.DONE, job.failure_reason
+        assert mesh_sizes == [8, 7]           # one shrink step sufficed
+        # the suffix re-plan changes the GOP total; progress must track it
+        assert job.parts_total == job.parts_done
+        assert job.encode_progress == 100.0
+        assert any("replanning" in line
+                   for line in coord.activity.fetch_job(job.id))
+        if oracle.oracle_available():
+            with open(job.output_path, "rb") as fp:
+                from thinvids_tpu.io.mp4 import demux_mp4
+
+                media = demux_mp4(fp.read())
+            assert len(oracle.decode_h264(media.annexb)) == 12
+
+    def test_single_device_mesh_cannot_replan_fails(self, tmp_path,
+                                                    clip_y4m):
+        class DeadEncoder:
+            def __init__(self, meta, settings, mesh):
+                import numpy as np
+                import jax
+                from jax.sharding import Mesh
+
+                self.inner = LocalExecutor._default_encoder(
+                    meta, settings,
+                    Mesh(np.array(jax.devices()[:1]), ("gop",)))
+
+            def __getattr__(self, name):
+                return getattr(self.inner, name)
+
+            def collect_wave(self, pending):
+                raise RuntimeError("single chip on fire")
+
+        snap = make_settings(gop_frames=4, qp=30,
+                             part_failure_max_retries=0,
+                             heartbeat_throttle_s=0.0)
+        coord, _ = make_rig(
+            tmp_path, settings=snap,
+            encoder_factory=lambda m, s, mesh: DeadEncoder(m, s, mesh))
+        job = coord.add_job(clip_y4m, VideoMeta(width=64, height=48,
+                                                num_frames=12))
+        job = coord.store.get(job.id)
+        assert job.status is Status.FAILED
+        assert "single chip on fire" in job.failure_reason
+
     def test_stopped_job_halts_between_waves(self, tmp_path, clip_y4m):
         coord_holder = {}
 

@@ -35,6 +35,16 @@ class HaltedError(RuntimeError):
     """Run token went stale mid-run (stop/restart/watchdog revocation)."""
 
 
+class _WaveExhausted(RuntimeError):
+    """One wave burned its whole retry budget; carries the segments the
+    failing range completed so an elastic replan can resume after them."""
+
+    def __init__(self, reason: str, completed: list) -> None:
+        super().__init__(reason)
+        self.reason = reason
+        self.completed = completed
+
+
 class LocalExecutor:
     """Runs reserved jobs on the local process's device mesh.
 
@@ -150,24 +160,106 @@ class LocalExecutor:
         segments, _stats = rc.encode_vbr2pass(
             frames, meta, target_kbps, base_qp=int(settings.qp), enc=enc,
             encode_fn=lambda e: self._encode_with_retry(
-                job, token, e, frames, settings),
+                job, token, e, frames, settings, allow_replan=False),
             on_pass=on_pass)
         return segments
 
     def _encode_with_retry(self, job: Job, token: str, enc, frames,
-                           settings) -> list:
-        """Depth-2 pipelined wave loop with per-wave retry + halt checks.
+                           settings, allow_replan: bool = True) -> list:
+        """Wave loop with per-wave retry, halt checks, and elastic
+        replan: when a wave exhausts its retry budget on a multi-device
+        mesh, the remaining frames are re-planned on a SHRUNKEN mesh and
+        encoding continues — the TPU analog of the reference's elastic
+        worker set (parts re-placed on healthy nodes,
+        worker/tasks.py:1845-2029; SURVEY §2.9 "Elastic DP"). A
+        single-device failure has nowhere left to shrink and fails the
+        job with attribution.
 
-        Staging stays lazy (stage_waves's bounded-HBM invariant): only the
-        <=2 in-flight waves keep their staged device arrays alive, and a
-        retried wave re-dispatches from its retained staged tuple.
+        `allow_replan=False` (the vbr2pass passes) fails instead of
+        replanning: a mesh change mid-pass would change the GOP count
+        under the QP solver and orphan the per-GOP QP map.
+        """
+        co = self.coordinator
+        total_gops = enc.plan(len(frames)).num_gops
+        segments: list = []
+        start_frame = 0
+        shrink_attempt = 0
+        while True:
+            try:
+                segments.extend(self._encode_range(
+                    job, token, enc, frames, start_frame, settings,
+                    total_gops, len(segments)))
+                segments.sort(key=lambda s: s.gop.index)
+                return segments
+            except _WaveExhausted as exc:
+                segments.extend(exc.completed)
+                shrink_attempt += 1
+                shrunk = (self._shrink_encoder(enc, settings,
+                                               shrink_attempt)
+                          if allow_replan else None)
+                if shrunk is None:
+                    raise RuntimeError(exc.reason) from exc
+                # completed waves are a contiguous frame prefix (waves
+                # collect in order); resume after it on the new mesh
+                start_frame = max(
+                    (s.gop.end_frame for s in segments), default=0)
+                # the suffix re-plans with a different device count, so
+                # the GOP total changes — keep progress honest
+                total_gops = len(segments) + shrunk.plan(
+                    len(frames) - start_frame).num_gops
+                co.update_progress(job.id, token, parts_total=total_gops)
+                co.activity.emit(
+                    "encode", f"wave retries exhausted; replanning "
+                    f"frames {start_frame}+ on {shrunk.num_devices} "
+                    f"devices (was {enc.num_devices})",
+                    job_id=job.id, host=self.host)
+                enc = shrunk
+
+    def _shrink_encoder(self, enc, settings, attempt: int):
+        """Encoder over a shrunken copy of enc's mesh, or None when it
+        cannot shrink further (or the encoder exposes no mesh).
+
+        A Python-level wave failure carries no device attribution, so
+        the shrink is blind — it drops devices from the tail, doubling
+        the count each consecutive attempt (1, 2, 4, ...) so a bad
+        device at a low index is excluded in O(log n) rounds rather
+        than n full retry budgets."""
+        mesh = getattr(enc, "mesh", None)
+        meta = getattr(enc, "meta", None)
+        if mesh is None or meta is None:
+            return None
+        devices = list(mesh.devices.flat)
+        if len(devices) <= 1:
+            return None
+        drop = min(len(devices) - 1, 2 ** (attempt - 1))
+        import numpy as np
+        from jax.sharding import Mesh
+
+        return self._encoder_factory(
+            meta, settings, Mesh(np.array(devices[:-drop]), ("gop",)))
+
+    def _encode_range(self, job: Job, token: str, enc, frames,
+                      start_frame: int, settings, total_gops: int,
+                      done0: int) -> list:
+        """Depth-2 pipelined wave loop over frames[start_frame:].
+
+        Staging stays lazy (stage_waves's bounded-HBM invariant): only
+        the <=2 in-flight waves keep their staged device arrays alive,
+        and a retried wave re-dispatches from its retained staged tuple.
+        Raises _WaveExhausted (carrying the range's completed segments)
+        when one wave fails `part_failure_max_retries` times.
         """
         co = self.coordinator
         max_retries = int(settings.part_failure_max_retries)
-        total_gops = enc.plan(len(frames)).num_gops
-        staged_iter = enumerate(enc.stage_waves(frames))
+        if start_frame:
+            # GOP indices / frame ranges restart at 0 for the subrange;
+            # offset emitted segments so ordering + idr_pic_id stay
+            # globally consistent with already-completed ones
+            enc.gop_index_offset = done0
+            enc.frame_offset = start_frame
+        staged_iter = enumerate(enc.stage_waves(frames[start_frame:]))
         segments: list = []
-        done = 0
+        done = done0
         pending: deque = deque()        # (idx, staged, handle)
         attempts: dict[int, int] = {}
 
@@ -196,9 +288,9 @@ class LocalExecutor:
                 n = attempts.get(i, 0) + 1
                 attempts[i] = n
                 if n > max_retries:
-                    raise RuntimeError(
+                    raise _WaveExhausted(
                         f"wave {i} failed after {n - 1} retries: "
-                        f"{type(exc).__name__}: {exc}") from exc
+                        f"{type(exc).__name__}: {exc}", segments) from exc
                 co.activity.emit(
                     "encode", f"wave {i} attempt {n} failed, retrying: "
                     f"{exc}", job_id=job.id, host=self.host)
@@ -212,5 +304,4 @@ class LocalExecutor:
                 encode_progress=100.0 * done / max(1, total_gops))
             co.heartbeat_job(job.id, token, "encode", host=self.host,
                              note=f"{done}/{total_gops} GOPs")
-        segments.sort(key=lambda s: s.gop.index)
         return segments

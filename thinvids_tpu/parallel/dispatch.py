@@ -231,6 +231,12 @@ class GopShardEncoder:
         #: GOPs absent from the map encode at the base `qp`; slice
         #: headers carry the delta vs PPS init_qp.
         self.gop_qp: dict[int, int] = {}
+        #: Elastic-replan continuation: when encoding a clip SUFFIX on a
+        #: rebuilt mesh, emitted GopSpecs shift by these so indices /
+        #: frame ranges (and idr_pic_id) stay globally consistent with
+        #: the segments already completed (cluster/executor.py).
+        self.gop_index_offset = 0
+        self.frame_offset = 0
 
     @property
     def num_devices(self) -> int:
@@ -356,6 +362,13 @@ class GopShardEncoder:
         # caller mutating gop_qp between passes must not desync slices
         # already in flight).
         qps_host = np.asarray(qpsd)
+        if self.gop_index_offset or self.frame_offset:
+            import dataclasses as _dc
+
+            wave = [_dc.replace(g, index=g.index + self.gop_index_offset,
+                                start_frame=(g.start_frame
+                                             + self.frame_offset))
+                    for g in wave]
         for gi, gop in enumerate(wave):
             gop_qp = int(qps_host[gi])
             if self.inter:
