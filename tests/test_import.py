@@ -1,0 +1,87 @@
+"""Import tool: discovery, name normalization, watch-drop, API submit.
+(The pipeline-facing half of the reference's rip tooling,
+/root/reference/rips/dvd_rip_queue.py — see tools/import_media.py.)"""
+
+import os
+
+import numpy as np
+
+from thinvids_tpu.core.types import Frame, VideoMeta
+from thinvids_tpu.io.y4m import write_y4m
+from thinvids_tpu.tools.import_media import (
+    import_to_watch,
+    main,
+    normalized_name,
+    plan_imports,
+)
+
+
+def _write_clip(path, n=4, w=48, h=32):
+    frames = [Frame(np.full((h, w), 90, np.uint8),
+                    np.full((h // 2, w // 2), 110, np.uint8),
+                    np.full((h // 2, w // 2), 140, np.uint8))
+              for _ in range(n)]
+    write_y4m(str(path), VideoMeta(width=w, height=h, fps_num=30,
+                                   fps_den=1, num_frames=n), frames)
+
+
+class TestNaming:
+    def test_year_extracted(self):
+        assert normalized_name("/x/The.Big.Film.1994.y4m", 1080, "h264") \
+            == "The Big Film (1994) 1080p h264.y4m"
+
+    def test_no_year(self):
+        assert normalized_name("/x/home_video.y4m", 480, "rawvideo") \
+            == "Home Video 480p rawvideo.y4m"
+
+    def test_parenthesized_year(self):
+        got = normalized_name("/x/Movie (2021).y4m", 720, "h264")
+        assert got == "Movie (2021) 720p h264.y4m"
+
+
+class TestPlanning:
+    def test_plan_probes_and_flags_errors(self, tmp_path):
+        _write_clip(tmp_path / "good.y4m")
+        (tmp_path / "bad.y4m").write_bytes(b"not media")
+        (tmp_path / "ignored.txt").write_text("x")
+        plans = plan_imports(str(tmp_path))
+        by_src = {os.path.basename(p["src"]): p for p in plans}
+        assert set(by_src) == {"good.y4m", "bad.y4m"}
+        assert by_src["good.y4m"]["width"] == 48
+        assert "error" in by_src["bad.y4m"]
+
+    def test_import_to_watch_atomic_name(self, tmp_path):
+        _write_clip(tmp_path / "Clip.2001.y4m")
+        plans = plan_imports(str(tmp_path))
+        dest = import_to_watch(plans[0], str(tmp_path / "watch"),
+                               "movies")
+        assert dest.endswith("movies/Clip (2001) 32p rawvideo.y4m")
+        assert os.path.exists(dest)
+        assert not any(f.endswith(".importing") for f in
+                       os.listdir(os.path.dirname(dest)))
+
+
+class TestCli:
+    def test_dry_run_prints_plan(self, tmp_path, capsys):
+        _write_clip(tmp_path / "a.y4m")
+        rc = main([str(tmp_path), "--watch-root",
+                   str(tmp_path / "watch"), "--dry-run"])
+        out = capsys.readouterr().out
+        assert rc == 0 and out.startswith("PLAN ")
+        assert not (tmp_path / "watch").exists()   # dry run copies nothing
+
+    def test_api_submit(self, tmp_path):
+        from thinvids_tpu.api import ApiServer
+        from thinvids_tpu.cluster.coordinator import Coordinator
+
+        co = Coordinator()
+        server = ApiServer(co).start()
+        try:
+            _write_clip(tmp_path / "b.y4m")
+            rc = main([str(tmp_path), "--api", server.url])
+            assert rc == 0
+            jobs = co.store.list()
+            assert len(jobs) == 1
+            assert jobs[0].input_path.endswith("b.y4m")
+        finally:
+            server.stop()
