@@ -73,6 +73,63 @@ class TestUi:
         assert "thinvids" in page
 
 
+class TestBrowsePreviewStamp:
+    def test_browse_list_traversal_safe(self, api, tmp_path):
+        server, co, execu, _ = api
+        server.browse_roots["watch"] = str(tmp_path)
+        (tmp_path / "sub").mkdir()
+        (tmp_path / "a.y4m").write_bytes(b"x")
+        code, out = call(f"{server.url}/browse/list?root=watch")
+        assert code == 200
+        names = {e["name"]: e for e in out["entries"]}
+        assert names["sub"]["dir"] is True
+        assert names["a.y4m"]["size"] == 1
+        code, out = call(f"{server.url}/browse/list?root=watch&path=../..")
+        assert code == 400
+        code, out = call(f"{server.url}/browse/list?root=nope")
+        assert code == 400
+
+    def test_preview_streams_output(self, api):
+        server, co, execu, tmp_path = api
+        clip = tmp_path / "movie.y4m"
+        make_clip(str(clip))
+        code, job = call(f"{server.url}/add_job", "POST",
+                         {"input_path": str(clip), "auto_start": False})
+        jid = job["id"]
+        code, _ = call(f"{server.url}/preview/{jid}")
+        assert code == 404                       # no output yet
+        call(f"{server.url}/start_job/{jid}", "POST")
+        execu.join(timeout=120)
+        req = urllib.request.Request(f"{server.url}/preview/{jid}")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == "video/mp4"
+            data = resp.read()
+        assert data[4:8] == b"ftyp"
+
+    def test_stamp_job_creates_stamped_copy(self, api):
+        from thinvids_tpu.io.y4m import read_y4m
+        from thinvids_tpu.tools.stamp import read_stamp, stamp_width_px
+
+        server, co, execu, tmp_path = api
+        clip = tmp_path / "movie.y4m"
+        # wide enough for the 16-bit stamp
+        make_clip(str(clip), n=4, w=stamp_width_px(), h=32)
+        code, job = call(f"{server.url}/add_job", "POST",
+                         {"input_path": str(clip), "auto_start": False})
+        jid = job["id"]
+        code, out = call(f"{server.url}/stamp_job/{jid}", "POST", {})
+        assert code == 200 and out["status"] == "ready"
+        stamped = tmp_path / "movie.stamped.y4m"
+        assert stamped.exists()
+        _meta, frames = read_y4m(str(stamped))
+        assert [read_stamp(f.y) for f in frames] == [0, 1, 2, 3]
+        # a NEW job for the stamped file was registered
+        code, listing = call(f"{server.url}/jobs")
+        paths = {j["input_path"] for j in listing["jobs"]}
+        assert str(stamped) in paths
+
+
 class TestLifecycle:
     def test_full_job_lifecycle_over_http(self, api):
         server, co, execu, tmp_path = api

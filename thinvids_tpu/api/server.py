@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -62,12 +63,28 @@ _SORTABLE = {f.name for f in dataclasses.fields(Job)
              if str(f.type) in ("str", "int", "float")} | {"status"}
 
 
+class _FileResponse:
+    """Handler payload sentinel: stream a file instead of JSON (the
+    reference's send_file preview, manager/app.py:2402-2460)."""
+
+    def __init__(self, path: str, content_type: str) -> None:
+        self.path = path
+        self.content_type = content_type
+
+
 class ApiServer:
-    """Threaded HTTP server bound to a Coordinator instance."""
+    """Threaded HTTP server bound to a Coordinator instance.
+
+    `browse_roots` maps root names → directories for /browse/list (the
+    reference browsed its watch + source_media NFS mounts,
+    manager/app.py:1583-1642).
+    """
 
     def __init__(self, coordinator: Coordinator, host: str = "127.0.0.1",
-                 port: int = 0) -> None:
+                 port: int = 0,
+                 browse_roots: dict[str, str] | None = None) -> None:
         self.coordinator = coordinator
+        self.browse_roots = dict(browse_roots or {})
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -104,6 +121,28 @@ class ApiServer:
                 self.end_headers()
                 self.wfile.write(content)
 
+            def _reply_file(self, fr: _FileResponse) -> None:
+                # open BEFORE sending headers: a vanished file must 404,
+                # not corrupt an already-started 200 stream
+                fp = open(fr.path, "rb")
+                try:
+                    size = os.fstat(fp.fileno()).st_size
+                    self.send_response(200)
+                    self.send_header("Content-Type", fr.content_type)
+                    self.send_header("Content-Length", str(size))
+                    self.end_headers()
+                    try:
+                        while True:
+                            chunk = fp.read(1 << 20)
+                            if not chunk:
+                                break
+                            self.wfile.write(chunk)
+                    except OSError:
+                        return          # client went away mid-stream;
+                                        # never append a second response
+                finally:
+                    fp.close()
+
             def _dispatch(self, method: str) -> None:
                 url = urlparse(self.path)
                 query = {k: v[-1] for k, v in parse_qs(url.query).items()}
@@ -116,6 +155,12 @@ class ApiServer:
                     body = self._body() if method in ("POST", "PUT") else {}
                     status, payload = api.route(method, url.path, query,
                                                 body)
+                    if isinstance(payload, _FileResponse):
+                        try:
+                            self._reply_file(payload)
+                        except OSError:
+                            self._reply(404, {"error": "file unavailable"})
+                        return
                     self._reply(status, payload)
                 except ApiError as exc:
                     self._reply(exc.status, {"error": exc.message})
@@ -185,6 +230,9 @@ class ApiServer:
         ("GET", r"^/metrics_snapshot$", "metrics_snapshot"),
         ("GET", r"^/settings$", "get_settings"),
         ("POST", r"^/settings$", "post_settings"),
+        ("GET", r"^/browse/list$", "browse_list"),
+        ("GET", r"^/preview/(?P<job_id>[\w-]+)$", "preview"),
+        ("POST", r"^/stamp_job/(?P<job_id>[\w-]+)$", "stamp_job"),
     ]
 
     def route(self, method: str, path: str, query: dict[str, str],
@@ -362,6 +410,90 @@ class ApiServer:
         if not self.coordinator.registry.delete(host):
             raise ApiError(404, f"no node {host}")
         return 200, {"deleted": host}
+
+    def _h_browse_list(self, query, body) -> tuple[int, Any]:
+        """Traversal-safe directory listing over the configured roots
+        (reference /browse/list, manager/app.py:1583-1642)."""
+        root_name = query.get("root", "")
+        root = self.browse_roots.get(root_name)
+        if root is None:
+            raise ApiError(400, f"unknown browse root {root_name!r}; "
+                                f"have {sorted(self.browse_roots)}")
+        rel = query.get("path", "")
+        base = os.path.realpath(root)
+        target = os.path.realpath(os.path.join(base, rel))
+        if target != base and not target.startswith(base + os.sep):
+            raise ApiError(400, "path escapes the browse root")
+        if not os.path.isdir(target):
+            raise ApiError(404, f"no such directory {rel!r}")
+        entries = []
+        for name in sorted(os.listdir(target)):
+            if name.startswith("."):
+                continue
+            p = os.path.join(target, name)
+            try:
+                is_dir = os.path.isdir(p)
+                size = 0 if is_dir else os.path.getsize(p)
+            except OSError:
+                continue          # dangling symlink / deleted mid-scan:
+                                  # one bad entry must not 500 the list
+            entries.append({"name": name, "dir": is_dir, "size": size})
+        rel_out = os.path.relpath(target, base)
+        return 200, {"root": root_name,
+                     "path": "" if rel_out == "." else rel_out,
+                     "entries": entries}
+
+    def _h_preview(self, query, body, job_id) -> tuple[int, Any]:
+        """Stream a DONE job's output file (reference /preview/<id>)."""
+        job = self._get_job(job_id)
+        if not job.output_path or not os.path.exists(job.output_path):
+            raise ApiError(404, "job has no output file")
+        return 200, _FileResponse(job.output_path, "video/mp4")
+
+    def _h_stamp_job(self, query, body, job_id) -> tuple[int, Any]:
+        """Create a frame-index-watermarked copy of the job's source and
+        register it as a NEW job (the reference's stamp verification
+        task, worker/tasks.py:2314-2613 — there a drawtext re-encode,
+        here the machine-decodable stamp the seam tests read back).
+        The source job's own status is restored afterwards (stamping a
+        DONE job must not erase its terminal state). Runs inline for
+        y4m-sized sources; pass {"sync": false} to spawn a thread."""
+        job = self._get_job(job_id)
+        if job.status.is_active:
+            raise ApiError(409, f"job is {job.status.value}; stop it first")
+        co = self.coordinator
+        prior_status = job.status
+        co.store.update(job_id, lambda j: setattr(j, "status",
+                                                  Status.STAMPING))
+
+        def work() -> None:
+            from ..ingest.decode import read_video
+            from ..ingest.probe import probe_video
+            from ..io.y4m import write_y4m
+            from ..tools.stamp import stamp_frame
+
+            try:
+                meta, frames, _audio = read_video(job.input_path)
+                stamped = [stamp_frame(f, i)
+                           for i, f in enumerate(frames)]
+                base, _ext = os.path.splitext(job.input_path)
+                out = base + ".stamped.y4m"
+                write_y4m(out, meta, stamped)
+                co.add_job(out, meta=probe_video(out), auto_start=False)
+                co.activity.emit("stamp", f"stamped copy at {out}",
+                                 job_id=job_id)
+            except Exception as exc:     # noqa: BLE001 - record & restore
+                co.activity.emit("error", f"stamp failed: {exc}",
+                                 job_id=job_id)
+            finally:
+                co.store.update(job_id, lambda j: setattr(
+                    j, "status", prior_status))
+
+        if body.get("sync", True):
+            work()
+        else:
+            threading.Thread(target=work, daemon=True).start()
+        return 200, {"status": self._get_job(job_id).status.value}
 
     def _h_metrics_snapshot(self, query, body) -> tuple[int, Any]:
         metrics = {w.host: dict(w.metrics, last_seen=w.last_seen)

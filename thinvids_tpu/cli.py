@@ -41,7 +41,11 @@ def run_coordinator(args: argparse.Namespace) -> None:
     # gate failed once would sit queued forever
     co.start_background()
 
-    api = ApiServer(co, host=args.host, port=args.port).start()
+    roots = {name: path for name, path in
+             (("watch", args.watch_dir), ("library", args.output_dir))
+             if path}
+    api = ApiServer(co, host=args.host, port=args.port,
+                    browse_roots=roots).start()
     log.info("api + dashboard on %s", api.url)
 
     # Local agent: the coordinator host reports its own health, and its
