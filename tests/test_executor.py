@@ -149,6 +149,22 @@ class TestEndToEnd:
         assert "1 retries" in job.failure_reason
         assert "device on fire" in job.failure_reason
 
+    def test_profile_dir_emits_device_trace(self, tmp_path, clip_y4m):
+        import os
+
+        trace_dir = tmp_path / "traces"
+        snap = make_settings(gop_frames=4, qp=30,
+                             heartbeat_throttle_s=0.0,
+                             profile_dir=str(trace_dir))
+        coord, _ = make_rig(tmp_path, settings=snap)
+        job = coord.add_job(clip_y4m, VideoMeta(width=64, height=48,
+                                                num_frames=12))
+        job = coord.store.get(job.id)
+        assert job.status is Status.DONE, job.failure_reason
+        files = [os.path.join(r, f) for r, _d, fs in os.walk(trace_dir)
+                 for f in fs]
+        assert files, "profiler trace directory is empty"
+
     def test_elastic_replan_on_shrunken_mesh(self, tmp_path, clip_y4m):
         """A wave that keeps failing on the full mesh exhausts its
         budget; the executor re-plans the remaining frames on a smaller

@@ -114,12 +114,14 @@ class LocalExecutor:
 
             stage = "encode"
             target_kbps = float(settings.get("target_bitrate_kbps", 0.0))
-            if str(settings.rc_mode) == "vbr2pass" and target_kbps > 0:
-                segments = self._encode_vbr2pass(
-                    job, token, enc, frames, settings, meta, target_kbps)
-            else:
-                segments = self._encode_with_retry(job, token, enc,
-                                                   frames, settings)
+            with self._maybe_trace(settings, job):
+                if str(settings.rc_mode) == "vbr2pass" and target_kbps > 0:
+                    segments = self._encode_vbr2pass(
+                        job, token, enc, frames, settings, meta,
+                        target_kbps)
+                else:
+                    segments = self._encode_with_retry(job, token, enc,
+                                                       frames, settings)
 
             stage = "stitch"
             co.heartbeat_job(job.id, token, stage, host=self.host)
@@ -140,6 +142,21 @@ class LocalExecutor:
         except Exception as exc:            # noqa: BLE001 - attribute & fail
             co.fail_job(job.id, token, stage=stage, host=self.host,
                         reason=f"{type(exc).__name__}: {exc}")
+
+    @staticmethod
+    def _maybe_trace(settings, job: Job):
+        """jax.profiler trace of the encode stage when `profile_dir` is
+        set (SURVEY §5.1: the reference had activity timers only; here
+        per-kernel device timelines land beside the job's events)."""
+        import contextlib
+
+        profile_dir = str(settings.get("profile_dir", "") or "")
+        if not profile_dir:
+            return contextlib.nullcontext()
+        import jax
+
+        return jax.profiler.trace(
+            os.path.join(profile_dir, f"job-{job.id[:8]}"))
 
     def _encode_vbr2pass(self, job: Job, token: str, enc, frames,
                          settings, meta, target_kbps: float) -> list:

@@ -42,6 +42,8 @@ DEFAULT_SETTINGS: dict[str, Any] = {
     "qp": 27,
     "target_height": 1080,
     "software_fallback": True,       # pure-JAX CPU path when no TPU
+    "profile_dir": "",               # non-empty: jax.profiler trace of
+                                     # the encode stage lands here
     # liveness / watchdog budgets (seconds)
     "metrics_ttl_s": 15.0,
     "active_window_s": 5.0,
@@ -233,7 +235,8 @@ def reset_live_settings() -> None:
 # (/root/reference/manager/app.py:2746-2812).
 JOB_SETTING_KEYS = frozenset(
     {"gop_frames", "target_segment_frames", "qp", "target_height", "rc_mode",
-     "target_bitrate_kbps", "max_segments", "software_fallback"}
+     "target_bitrate_kbps", "max_segments", "software_fallback",
+     "profile_dir"}
 )
 
 
