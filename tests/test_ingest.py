@@ -197,3 +197,24 @@ class TestCoordinatorGlue:
         # no resubmission on the next pass
         assert ing.scan_once() == []
         assert len(co.store.list()) == 1
+
+    def test_manually_added_job_not_double_queued(self, tmp_path):
+        """A file already registered via add_job (manual submission, a
+        stamp copy) is ledgered, not re-queued — reference
+        _mark_watcher_processed, app.py:828-870."""
+        from thinvids_tpu.cluster.coordinator import Coordinator
+        from thinvids_tpu.ingest.probe import probe_video
+
+        co = Coordinator()
+        watch = tmp_path / "watch"
+        watch.mkdir()
+        clip = watch / "manual.y4m"
+        make_clip(str(clip), n=3)
+        co.add_job(str(clip), meta=probe_video(str(clip)),
+                   auto_start=False)
+        led = FileLedger(str(tmp_path / "processed.log"))
+        ing = WatchIngester(str(watch), led, coordinator_submitter(co),
+                            stable_checks=1)
+        assert ing.scan_once() == ["manual.y4m"]   # ledgered...
+        assert len(co.store.list()) == 1           # ...but no new job
+        assert ing.scan_once() == []

@@ -259,6 +259,13 @@ def coordinator_submitter(coordinator, activity_host: str = "watcher"):
     from .probe import ProbeError, probe_video
 
     def submit(abs_path: str) -> bool:
+        # A job already registered for this path (manual /add_job,
+        # stamp copies written into the watch tree) must not re-queue:
+        # returning True ledgers it, the analog of the reference
+        # manager writing the watcher ledger for manual submissions
+        # (_mark_watcher_processed, app.py:828-870).
+        if any(j.input_path == abs_path for j in coordinator.store):
+            return True
         try:
             meta = probe_video(abs_path)
         except ProbeError as exc:
