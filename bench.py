@@ -101,16 +101,23 @@ def _run_pipeline(w: int, h: int, nframes: int, qp: int, gop_frames: int,
     # unreliable over tunneled devices, and compiling a fresh reduction
     # here would land compile time inside the timed region; an existing
     # output fetch does neither. Device execution is in-order, so the
-    # last wave's completion implies all prior waves'.
-    t0 = time.perf_counter()
-    outs = [enc.dispatch_wave(wv)[-1] for wv in waves]
-    _ = jax.device_get(outs[-1][1])
-    t_dev = time.perf_counter() - t0
+    # last wave's completion implies all prior waves'. Best of 3, same
+    # rationale as the e2e passes below.
+    t_dev = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        outs = [enc.dispatch_wave(wv)[-1] for wv in waves]
+        _ = jax.device_get(outs[-1][1])
+        t_dev = min(t_dev, time.perf_counter() - t0)
 
-    # End-to-end production path.
-    t0 = time.perf_counter()
-    stream = concat_segments(enc.encode_waves(waves))
-    t_e2e = time.perf_counter() - t0
+    # End-to-end production path: best of 3 passes — the tunneled
+    # device link adds run-to-run noise (observed ±15%) that a single
+    # pass would bake into the reported number.
+    t_e2e = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        stream = concat_segments(enc.encode_waves(waves))
+        t_e2e = min(t_e2e, time.perf_counter() - t0)
     return (nframes / t_e2e, nframes / t_dev, len(stream),
             _quality(frames, stream) if quality else {})
 
