@@ -68,6 +68,74 @@ class TestDemux:
         with pytest.raises(ValueError):
             demux_mp4(b"\x00\x00\x00\x08free")
 
+    def test_multi_chunk_and_co64_layout(self):
+        """Real-world files spread samples over many chunks and use
+        64-bit co64 offsets; the sample walk must reassemble them."""
+        import struct
+
+        frames, meta = _clip()
+        stream = encode_clip_sharded(frames, meta, qp=27, gop_frames=4)
+        base = mux_mp4(stream, meta)
+        ref = demux_mp4(base)
+
+        # rewrite the single-chunk layout as per-sample chunks + co64
+        samples = ref.video.samples
+        mdat_payload = b"".join(samples)
+        # offsets of each sample within a NEW mdat placed after moov
+        def rebuild(moov: bytes) -> bytes:
+            ftyp = base[:base.find(b"moov") - 4]
+            mdat = struct.pack(">I", 8 + len(mdat_payload)) + b"mdat" \
+                + mdat_payload
+            return ftyp + moov + mdat
+
+        # locate the original stbl pieces and surgically replace
+        # stsc (1 sample/chunk) + stco -> co64 with per-sample offsets
+        i = base.find(b"stsc") - 4
+        size = struct.unpack_from(">I", base, i)[0]
+        old_stsc = base[i:i + size]
+        new_stsc = struct.pack(">I", 8 + 4 + 4 + 12) + b"stsc" \
+            + struct.pack(">II", 0, 1) + struct.pack(">III", 1, 1, 1)
+        j = base.find(b"stco") - 4
+        size_co = struct.unpack_from(">I", base, j)[0]
+        old_stco = base[j:j + size_co]
+
+        # first pass with dummy offsets to learn the layout size
+        def co64_box(offsets):
+            return struct.pack(">I", 8 + 8 + 8 * len(offsets)) + b"co64" \
+                + struct.pack(">II", 0, len(offsets)) \
+                + b"".join(struct.pack(">Q", o) for o in offsets)
+
+        moov_start = base.find(b"moov") - 4
+        moov_size = struct.unpack_from(">I", base, moov_start)[0]
+        moov = base[moov_start:moov_start + moov_size]
+
+        def patch(moov, offsets):
+            m = moov.replace(old_stsc, new_stsc).replace(
+                old_stco, co64_box(offsets))
+            # fix enclosing box sizes (moov/trak/mdia/minf/stbl grow)
+            delta = len(m) - len(moov)
+            for kind in (b"moov", b"trak", b"mdia", b"minf", b"stbl"):
+                k = m.find(kind) - 4
+                m = (m[:k] + struct.pack(
+                    ">I", struct.unpack_from(">I", m, k)[0] + delta)
+                    + m[k + 4:])
+            return m
+
+        dummy = patch(moov, [0] * len(samples))
+        ftyp_len = base.find(b"moov") - 4
+        data_start = ftyp_len + len(dummy) + 8
+        offsets = []
+        pos = data_start
+        for s in samples:
+            offsets.append(pos)
+            pos += len(s)
+        rebuilt = rebuild(patch(moov, offsets))
+        got = demux_mp4(rebuilt)
+        assert got.video.samples == samples
+        assert got.num_frames == ref.num_frames
+        norm = lambda s: s.replace(b"\x00\x00\x00\x01", b"|")
+        assert norm(got.annexb) == norm(ref.annexb)
+
 
 class TestProbeMp4:
     def test_probe_matches_content(self, tmp_path):
