@@ -6,7 +6,8 @@ prediction (§8.4.1.3), P_Skip inference (§8.4.1.1), inter CBP mapping
 (Table 9-4), and the CAVLC MB layer for P_L0_16x16 macroblocks.
 
 Scope: one reference frame (the previous recon), whole-MB partitions,
-integer-pel MVs, all-inter P frames (no intra refresh MBs yet).
+half-pel MVs (quarter-pel mvd coding), all-inter P frames (no intra
+refresh MBs yet).
 """
 
 from __future__ import annotations
@@ -172,7 +173,7 @@ def pack_p_slice(mv: np.ndarray, luma16: np.ndarray, chroma_dc: np.ndarray,
                  native: bool | None = None) -> bytes:
     """Entropy-pack one P picture into an Annex-B NAL unit.
 
-    mv: (nmb, 2) integer-pel (dy, dx); luma16: (nmb, 16, 16) z-scan
+    mv: (nmb, 2) half-pel (dy, dx); luma16: (nmb, 16, 16) z-scan
     blocks of 16 zig-zag coeffs; chroma_dc: (nmb, 2, 4);
     chroma_ac: (nmb, 2, 4, 15).
 
@@ -220,10 +221,11 @@ def pack_p_slice(mv: np.ndarray, luma16: np.ndarray, chroma_dc: np.ndarray,
             bw.ue(skip_run)                    # mb_skip_run
             skip_run = 0
             bw.ue(0)                           # mb_type = P_L0_16x16
-            # mvd in quarter-pel units, horizontal component first
-            # (§7.3.5.1 compIdx order); our mv layout is (dy, dx).
-            bw.se(4 * int(mv[mi, 1] - mvp[mi, 1]))   # mvd_l0 x
-            bw.se(4 * int(mv[mi, 0] - mvp[mi, 0]))   # mvd_l0 y
+            # mv is in half-pel units; mvd is coded in quarter-pel
+            # units, horizontal component first (§7.3.5.1 compIdx
+            # order); our mv layout is (dy, dx).
+            bw.se(2 * int(mv[mi, 1] - mvp[mi, 1]))   # mvd_l0 x
+            bw.se(2 * int(mv[mi, 0] - mvp[mi, 0]))   # mvd_l0 y
             bw.ue(CBP_INTER_TO_CODE[cbp])      # coded_block_pattern
             if cbp:
                 bw.se(0)                       # mb_qp_delta

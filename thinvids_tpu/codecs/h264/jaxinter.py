@@ -2,27 +2,20 @@
 compensation, residual transform/quant, closed-loop reconstruction.
 
 Replaces the inter coding half of the reference's ffmpeg encode op point
-(/root/reference/worker/tasks.py:1558-1586). TPU-shaped design — the
-governing constraint is that arbitrary per-MB gathers and tiny blocked
-layouts ((n, 16, 4, 4)) map terribly onto the VPU's (8, 128) registers,
-so every hot op works on whole (H, W) planes:
+(/root/reference/worker/tasks.py:1558-1586). TPU-shaped design:
 
-- Motion estimation + compensation are ONE fused candidate loop over
-  UNIFORM whole-frame shifts (`lax.dynamic_slice`, no gathers): each
-  candidate's shifted reference is SAD-reduced per MB and selected into
-  the prediction planes where it wins. Candidate centers come from a
-  quarter-resolution global-motion probe, the median of the previous
-  frame's vectors (the EPZS temporal predictor collapsed to its frame
-  mode), and zero — each refined over a small window. Per-MB deviation
-  beyond the windows is absorbed by residual coding; this trades a
-  little bitrate on chaotic motion for an order of magnitude in device
-  time vs per-MB search (the gather formulation measured ~93 ms/frame
-  at 1080p; this loop runs whole-frame slices at HBM bandwidth).
+- Motion estimation + compensation are ONE Pallas kernel pass per frame
+  (codecs/h264/jaxme.py): MXU-matmul SAD over static candidate windows
+  around dynamically re-anchored centers, half-pel 6-tap interpolation,
+  and a running per-MB best-(cost, mv, pred) select — the kernel emits
+  the final prediction planes, so MC never runs as a separate pass.
+  MVs are HALF-PEL units throughout.
 - Residual DCT/quant/dequant/IDCT run in PLANE layout: 4x4 butterflies
   as strided slices along H then W of the full frame — no (n, 16, 4, 4)
   relayout in the hot loop, int16 storage.
 - Frames chain through a `lax.scan` carry holding the recon planes and
-  the previous MV field.
+  the previous frame's median MV (the EPZS temporal predictor collapsed
+  to its frame mode, as one search center).
 
 The sequential P-slice entropy pack (skip runs, mvp/mvd, CBP) stays on
 host: codecs/h264/inter.py.
@@ -46,12 +39,9 @@ from .jaxcore import (
     _intra_core,
     _varying_zero,
 )
+from . import jaxme
 
-SEARCH_RANGE = 16          # integer-pel, each direction (max |mv|)
-_MV_LAMBDA = 6             # SAD bias per |mv| unit — favors short vectors
-_WIN_RAD = 4               # refinement radius around each candidate center
-_ZERO_RAD = 1              # refinement radius around the zero vector
-_COARSE = 4                # global-motion probe downsample factor
+SEARCH_RANGE = jaxme.SEARCH_RANGE      # integer-pel, each direction
 
 
 # ---------------------------------------------------------------------------
@@ -121,8 +111,11 @@ def _tile_plane(tbl, H, W):
 
 
 def _quant_plane(w, mf_plane, qp):
+    """Quantize an INTER coefficient plane with the f = (1 << qbits) / 6
+    rounding bias (over-rounding inter residuals inflates levels and
+    bitrate; the intra paths in jaxcore keep the standard 1/3)."""
     qbits = 15 + qp // 6
-    f = (1 << qbits) // 3
+    f = (1 << qbits) // 6
     z = (jnp.abs(w) * mf_plane + f) >> qbits
     return jnp.where(w < 0, -z, z)
 
@@ -132,153 +125,8 @@ def _dequant_plane(z, v_plane, qp):
 
 
 # ---------------------------------------------------------------------------
-# fused motion search + compensation (uniform-shift candidate loop)
-# ---------------------------------------------------------------------------
-
-def _mb_sad(ad, mbw: int, mbh: int):
-    """(H, W) int16 abs-diff plane → per-MB int32 SAD (mbh, mbw).
-
-    Two-stage reduce: 16-wide row sums stay int16 (≤ 16*255 = 4080),
-    the 16-row combine promotes to int32."""
-    H = ad.shape[0]
-    s1 = ad.reshape(H, mbw, 16).sum(-1, dtype=jnp.int16)
-    return s1.reshape(mbh, 16, mbw).sum(1, dtype=jnp.int32)
-
-
-def _box_sum(x, s: int):
-    """(H, W) → (H/s, W/s) sums of s x s boxes (int16-safe for s=4:
-    16 * 255 = 4080)."""
-    H, W = x.shape
-    return x.reshape(H // s, s, W // s, s).sum((1, 3), dtype=jnp.int16)
-
-
-def _candidate_centers(cur16, ref16, pred_mv, sr: int):
-    """Three search centers: quarter-res global-motion probe, the
-    previous frame's median MV (a (2,) vector), zero. All clamped so
-    every window candidate stays inside ±(sr).
-
-    The probe compares BOX-SUM (antialiased) quarter-res planes, not
-    subsampled ones: on grainy content a stride-s subsample only scores
-    exact alignments, so a true global shift that is not a multiple of
-    `_COARSE` would see a flat SAD surface; box sums keep the minimum's
-    basin visible at ±1 box, and the full-res ±_WIN_RAD window around
-    the chosen center absorbs the ≤ _COARSE-1 px quantization."""
-    qs = _COARSE
-    cq = _box_sum(cur16, qs)
-    rq = _box_sum(ref16, qs)
-    qsr = sr // qs
-    rq_pad = jnp.pad(rq, qsr, mode="edge")
-    qh, qw = cq.shape
-
-    def body(i, carry):
-        bc, bi = carry
-        dy, dx = i // (2 * qsr + 1), i % (2 * qsr + 1)
-        win = jax.lax.dynamic_slice(rq_pad, (dy, dx), (qh, qw))
-        cost = jnp.abs(cq - win).astype(jnp.int32).sum()
-        take = cost < bc
-        return jnp.where(take, cost, bc), jnp.where(take, i, bi)
-
-    big = jnp.int32(2**30) + _varying_zero(cur16)
-    _, bi = jax.lax.fori_loop(0, (2 * qsr + 1) ** 2, body,
-                              (big, _varying_zero(cur16)))
-    coarse = jnp.stack([bi // (2 * qsr + 1) - qsr,
-                        bi % (2 * qsr + 1) - qsr]) * qs
-
-    lim = sr - _WIN_RAD
-    return (jnp.clip(coarse, -lim, lim), jnp.clip(pred_mv, -lim, lim))
-
-
-def _search_mc(cy16, ry16, ru16, rv16, pred_mv, *, mbw: int, mbh: int,
-               sr: int):
-    """Fused ME+MC: evaluate uniform shift candidates (centers ± window,
-    zero ± 1), keeping per-MB the best (cost, mv) AND the corresponding
-    prediction planes — luma integer-pel, chroma 1/8-pel bilinear per
-    §8.4.2.2.2 (fracs ∈ {0, 4}), all via whole-plane dynamic slices.
-
-    cy16: (H, W) int16 current luma; r*16: int16 recon planes of the
-    reference frame. Returns (mv (mbh, mbw, 2) int32, pred_y, pred_u,
-    pred_v int16 planes).
-    """
-    H, W = cy16.shape
-    cpad = sr // 2 + 1
-    ref_y = jnp.pad(ry16, sr, mode="edge")
-    ref_u = jnp.pad(ru16, cpad, mode="edge")
-    ref_v = jnp.pad(rv16, cpad, mode="edge")
-
-    centers = _candidate_centers(cy16, ry16, pred_mv, sr)
-    # Candidate list: two windows of ±_WIN_RAD around the centers plus a
-    # ±_ZERO_RAD window around zero (skip-friendliness).
-    wr, zr = _WIN_RAD, _ZERO_RAD
-    win = 2 * wr + 1
-    zwin = 2 * zr + 1
-    offs = []
-    for cidx in range(len(centers)):
-        for i in range(win * win):
-            offs.append((cidx, i // win - wr, i % win - wr))
-    for i in range(zwin * zwin):
-        offs.append((-1, i // zwin - zr, i % zwin - zr))
-    n_cand = len(offs)
-    cand_center = jnp.asarray([o[0] for o in offs], jnp.int32)
-    cand_off = jnp.asarray([[o[1], o[2]] for o in offs], jnp.int32)
-    centers_arr = jnp.stack(list(centers) + [jnp.zeros(2, jnp.int32)])
-
-    zero = _varying_zero(cy16)
-
-    def body(i, carry):
-        bc, bmy, bmx, py, pu, pv = carry
-        c = centers_arr[cand_center[i]]
-        dy = c[0] + cand_off[i, 0]
-        dx = c[1] + cand_off[i, 1]
-        win_y = jax.lax.dynamic_slice(ref_y, (dy + sr, dx + sr), (H, W))
-        sad = _mb_sad(jnp.abs(cy16 - win_y), mbw, mbh)
-        cost = sad + _MV_LAMBDA * (jnp.abs(dy) + jnp.abs(dx))
-        take = cost < bc                                  # (mbh, mbw)
-
-        # chroma prediction for this shift (1/8-pel bilinear, frac 0|4)
-        ciy, cix = dy >> 1, dx >> 1
-        yf, xf = (dy & 1) * 4, (dx & 1) * 4
-
-        def bilerp(ref):
-            a = jax.lax.dynamic_slice(ref, (ciy + cpad, cix + cpad),
-                                      (H // 2, W // 2))
-            b = jax.lax.dynamic_slice(ref, (ciy + cpad, cix + cpad + 1),
-                                      (H // 2, W // 2))
-            cc = jax.lax.dynamic_slice(ref, (ciy + cpad + 1, cix + cpad),
-                                       (H // 2, W // 2))
-            d = jax.lax.dynamic_slice(ref, (ciy + cpad + 1, cix + cpad + 1),
-                                      (H // 2, W // 2))
-            return (((8 - xf) * (8 - yf) * a + xf * (8 - yf) * b
-                     + (8 - xf) * yf * cc + xf * yf * d + 32) >> 6
-                    ).astype(jnp.int16)
-
-        win_u = bilerp(ref_u)
-        win_v = bilerp(ref_v)
-
-        take_y = jnp.broadcast_to(take[:, None, :, None],
-                                  (mbh, 16, mbw, 16)).reshape(H, W)
-        take_c = jnp.broadcast_to(take[:, None, :, None],
-                                  (mbh, 8, mbw, 8)).reshape(H // 2, W // 2)
-        return (jnp.where(take, cost, bc),
-                jnp.where(take, dy, bmy).astype(jnp.int32),
-                jnp.where(take, dx, bmx).astype(jnp.int32),
-                jnp.where(take_y, win_y, py),
-                jnp.where(take_c, win_u, pu),
-                jnp.where(take_c, win_v, pv))
-
-    bc = jnp.full((mbh, mbw), 2**30, jnp.int32) + zero
-    bmy = jnp.zeros((mbh, mbw), jnp.int32) + zero
-    bmx = jnp.zeros((mbh, mbw), jnp.int32) + zero
-    py = jnp.zeros((H, W), jnp.int16) + zero.astype(jnp.int16)
-    pu = jnp.zeros((H // 2, W // 2), jnp.int16) + zero.astype(jnp.int16)
-    pv = jnp.zeros((H // 2, W // 2), jnp.int16) + zero.astype(jnp.int16)
-    bc, bmy, bmx, py, pu, pv = jax.lax.fori_loop(
-        0, n_cand, body, (bc, bmy, bmx, py, pu, pv))
-    mv = jnp.stack([bmy, bmx], axis=-1)
-    return mv, py, pu, pv
-
-
-# ---------------------------------------------------------------------------
 # P-frame residual coding in plane layout
+# (motion search + compensation live in jaxme.me_search)
 # ---------------------------------------------------------------------------
 
 def _dc_mask(H, W):
@@ -303,9 +151,19 @@ def _chroma_plane_to_blocks(z, mbw: int, mbh: int):
     return x[..., _ZZ]
 
 
+def _dc_pos_expand(dcr_grid, h, wd_):
+    """Place a (h/4, wd_/4) grid at the (0, 0) position of every 4x4
+    block of an (h, wd_) zero plane — an outer-product broadcast, not a
+    scatter (the .at[::4, ::4].set lowering measured ~2 ms/frame)."""
+    m4 = jnp.zeros((4, 4), dcr_grid.dtype).at[0, 0].set(1)
+    out = dcr_grid[:, None, :, None] * m4[None, :, None, :]
+    return out.reshape(h, wd_)
+
+
 def _encode_p_plane(cy, cu, cv, ry, ru, rv, pred_mv, qp, qpc, *, mbw: int,
-                    mbh: int, sr: int = SEARCH_RANGE, blocked: bool = True):
-    """One P frame given previous recon planes (int16).
+                    mbh: int, blocked: bool = True):
+    """One P frame given previous recon planes (int16). `pred_mv` is the
+    previous frame's median MV in half-pel units (a search center).
 
     `blocked=True` returns level arrays in the host packer's blocked
     layout (the conformance/host path). `blocked=False` skips the
@@ -321,8 +179,8 @@ def _encode_p_plane(cy, cu, cv, ry, ru, rv, pred_mv, qp, qpc, *, mbw: int,
     cu16 = cu.astype(jnp.int16)
     cv16 = cv.astype(jnp.int16)
 
-    mv, pred_y, pred_u, pred_v = _search_mc(
-        cy16, ry, ru, rv, pred_mv, mbw=mbw, mbh=mbh, sr=sr)
+    mv, pred_y, pred_u, pred_v, med_mv = jaxme.me_search(
+        cy16, ry, ru, rv, pred_mv, qp.astype(jnp.int32))
 
     qp32 = qp.astype(jnp.int32)
     mf_y = _tile_plane(_MF[qp32 % 6], H, W)
@@ -354,9 +212,10 @@ def _encode_p_plane(cy, cu, cv, ry, ru, rv, pred_mv, qp, qpc, *, mbw: int,
         c, dd = g[:, 1, :, 0], g[:, 1, :, 1]
         wd2 = jnp.stack([a + b + c + dd, a - b + c - dd,
                          a + b - c - dd, a - b - c + dd], axis=-1)
-        # chroma DC quant (jaxcore._chroma_dc_quant, plane-free)
+        # chroma DC quant (jaxcore._chroma_dc_quant with the inter
+        # rounding bias)
         qbits = 15 + qpc // 6
-        f = (1 << qbits) // 3
+        f = (1 << qbits) // 6
         mf00 = _MF[qpc % 6, 0, 0]
         zdc = (jnp.abs(wd2) * mf00 + 2 * f) >> (qbits + 1)
         zdc = jnp.where(wd2 < 0, -zdc, zdc)              # (mbh, mbw, 4)
@@ -375,9 +234,9 @@ def _encode_p_plane(cy, cu, cv, ry, ru, rv, pred_mv, qp, qpc, *, mbw: int,
                          jnp.stack([f10, f11], -1)], -2)  # (mbh,mbw,2,2)
         dcr = ((fdc * ls) << (qpc // 6)) >> 5
         dcr_grid = dcr.transpose(0, 2, 1, 3).reshape(2 * mbh, 2 * mbw)
-        dfull = dac.reshape(h // 4, 4, wd_ // 4, 4)
-        dfull = dfull.at[:, 0, :, 0].set(dcr_grid)
-        dfull = dfull.reshape(h, wd_)
+        # zac zeroes every DC position, so dequantized DC re-enters as
+        # an add of an expanded grid — no scatter.
+        dfull = dac + _dc_pos_expand(dcr_grid, h, wd_)
         rec = jnp.clip((_inv4_plane(dfull) + 32 >> 6) + pred, 0, 255
                        ).astype(jnp.int16)
         if blocked:
@@ -397,7 +256,6 @@ def _encode_p_plane(cy, cu, cv, ry, ru, rv, pred_mv, qp, qpc, *, mbw: int,
         chroma_dc = jnp.stack([udc, vdc]).astype(jnp.int16)  # (2, n, 4)
         chroma_ac = jnp.stack([uac, vac])                # (2, H/2, W/2)
 
-    med_mv = jnp.median(mv.reshape(-1, 2), axis=0).astype(jnp.int32)
     return (mv.reshape(n, 2), luma_levels, chroma_dc, chroma_ac,
             recon_y, recon_u, recon_v, med_mv)
 
@@ -468,10 +326,11 @@ def encode_gop_planes(ys, us, vs, qp, *, mbw: int, mbh: int):
     The host inverse is parallel/dispatch._unflatten_gop.
     """
     # The int8 MV transfer rides on search candidates being bounded by
-    # construction: centers clamp to ±(sr - _WIN_RAD) and offsets add
-    # ≤ _WIN_RAD, so |mv| ≤ SEARCH_RANGE per frame (each P frame
-    # references its immediate predecessor — MVs never accumulate).
-    if SEARCH_RANGE > 127:
+    # construction: centers clamp to ±(SEARCH_RANGE - window) pel and
+    # offsets add ≤ the window, so |mv| ≤ 2 * SEARCH_RANGE half-pel
+    # units per frame (each P frame references its immediate
+    # predecessor — MVs never accumulate).
+    if 2 * SEARCH_RANGE > 127:
         raise ValueError("SEARCH_RANGE exceeds the int8 MV transfer")
     qp = qp.astype(jnp.int32)
     qpc = _QPC[jnp.clip(qp, 0, 51)]

@@ -1,0 +1,742 @@
+"""Half-pel motion search + compensation as a Pallas TPU kernel.
+
+Replaces the r4 fused uniform-shift fori_loop in jaxinter._search_mc
+(~171 sequential device steps per P frame — launch-bound at 1080p) with
+ONE kernel launch per frame. The reference's analog is the motion
+search inside its hardware/software encoders
+(/root/reference/worker/tasks.py:1558-1586 — a black box to it; here it
+is the hot op and is built TPU-first):
+
+- 2D grid (MB row x 256-lane chunk): every VMEM buffer is chunk-sized,
+  so the footprint is resolution-independent and far under the 16 MB
+  physical VMEM (exceeding it silently corrupts rather than erroring
+  when a raised vmem_limit_bytes "permits" the allocation).
+- The per-MB SAD reduction rides the MXU: `dot(absdiff(16, 256),
+  S(256, 128))` with a 0/1 block-sum selector — a matmul, not a
+  vector-reduce tree. absdiff values (<= 255) are exact in bf16 and the
+  f32 accumulation is exact (< 2^24), so the SADs are integer-exact.
+  The per-MB -> per-lane take-mask expansion is also a matmul (with the
+  selector transpose): pltpu.repeat is a TILE repeat, not the element
+  repeat it looks like.
+- Search centers are folded in on the XLA side: the wide-padded
+  reference planes are re-anchored per center with dynamic slices and
+  stacked (leading dim 3), so the kernel needs no dynamic shifts at
+  all — every candidate is a STATIC slice of a plane stepped by
+  constant-shift rolls inside per-parity-class fori_loops.
+- Half-pel candidates read H.264 6-tap interpolation planes (b/h/j,
+  §8.4.2.2.1) built in-kernel over exactly the rows the windows touch;
+  chroma prediction is the §8.4.2.2.2 eighth-pel bilinear (centers are
+  even-pel, so candidate chroma fractions depend only on the window
+  offset).
+- Selection keeps a running per-MB best (cost, mv) and the running
+  best PREDICTION planes — motion compensation never runs as a
+  separate pass; the kernel emits pred ready for residual coding.
+
+The same search semantics are also implemented in plain XLA
+(`me_search_xla`) — the executable spec the kernel is validated
+against, and the path used off-TPU (CPU tests). Both produce identical
+(mv, pred).
+
+MV units are HALF-PEL throughout (the entropy packers scale mvd by 2
+to quarter-pel units).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+SEARCH_RANGE = 16          # max |mv| in integer pel
+_WR = 4                    # integer window radius (pel) around each center
+_HR = 3                    # fine half-pel window radius (half units)
+_ZR = 2                    # zero-window radius (half units)
+_CLIM = SEARCH_RANGE - _WR     # center clamp (pel)
+
+# MV-cost lambda per half-pel unit of |mv|, indexed by QP. Scales with
+# the quantizer like x264's lambda (2^((qp-12)/6) per bit, ~2.5 bits
+# per half unit of mvd): without QP scaling, half-pel candidates
+# "denoise" the reference's quant error on static content and beat the
+# zero vector, killing P_Skip runs.
+LAMBDA_H = np.maximum(
+    3, np.round(2.5 * 2.0 ** ((np.arange(52) - 12) / 6.0))).astype(np.int32)
+
+# Padded-layout constants (see _pad_luma/_pad_chroma): generous halos so
+# center roll + window offset + 6-tap reach never leaves real samples.
+_PV = 32                   # luma top pad rows (5 row-blocks of 16 in-kernel)
+_PH = 24                   # luma left pad lanes
+_PVC = 16                  # chroma top pad rows (5 row-blocks of 8)
+_PHC = 16                  # chroma left pad lanes
+# In-kernel row bases of the TRIMMED per-center planes (see run_center:
+# interpolation planes keep only the 32 luma / 24 chroma rows a window
+# can touch; trimming was the difference between fitting and
+# overflowing the 16 MB physical VMEM).
+_KPV = 8
+_KPVC = 8
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+# ---------------------------------------------------------------------------
+# offset tables (static; shared by kernel and XLA reference)
+#
+# Offsets around a center decompose into PARITY CLASSES — each class
+# reads one interpolation plane (full-pel / b / h / j) and forms a
+# regular grid whose luma row/lane step is exactly one sample of that
+# plane. The kernel walks each class with two nested fori_loops,
+# stepping a rolled plane by one row/lane per iteration, so every
+# candidate is a STATIC slice and the live set stays bounded (a fully
+# unrolled 267-candidate body made Mosaic's scoped-VMEM stack exceed
+# the 16 MB physical VMEM).
+# ---------------------------------------------------------------------------
+
+def _window_classes(int_rad_pel: int, fine_rad_half: int
+                    ) -> list[tuple[tuple[int, int], list[int], list[int]]]:
+    """[(parity (py, px), wys, wxs)] — the ± `int_rad_pel` integer grid
+    plus the ± `fine_rad_half` fine grid's non-integer parities, all in
+    half-pel units."""
+    ir, fr = int_rad_pel, fine_rad_half
+    evens = [w for w in range(-fr, fr + 1) if w % 2 == 0]
+    odds = [w for w in range(-fr, fr + 1) if abs(w) % 2 == 1]
+    return [
+        ((0, 0), [2 * d for d in range(-ir, ir + 1)],
+         [2 * d for d in range(-ir, ir + 1)]),
+        ((0, 1), evens, odds),      # horizontal half (b plane)
+        ((1, 0), odds, evens),      # vertical half (h plane)
+        ((1, 1), odds, odds),       # diagonal half (j plane)
+    ]
+
+
+CENTER_CLASSES = _window_classes(_WR, _HR)
+ZERO_CLASSES = _window_classes(_ZR // 2, _ZR)
+
+
+def _class_offsets(classes) -> list[tuple[int, int]]:
+    return [(wy, wx) for (_par, wys, wxs) in classes
+            for wy in wys for wx in wxs]
+
+
+#: (center_index, wy, wx) in selection order; strict '<' keeps the first
+#: best, so earlier entries win ties. Center 2 is the zero vector.
+OFFSET_TABLE: list[tuple[int, int, int]] = (
+    [(0,) + o for o in _class_offsets(CENTER_CLASSES)]
+    + [(1,) + o for o in _class_offsets(CENTER_CLASSES)]
+    + [(2,) + o for o in _class_offsets(ZERO_CLASSES)]
+)
+
+
+# ---------------------------------------------------------------------------
+# H.264 6-tap half-pel interpolation (§8.4.2.2.1) — shared math
+# ---------------------------------------------------------------------------
+
+def _tap6_lane(x, roll):
+    """6-tap across lanes: out[l] = x[l-2] -5x[l-1] +20x[l] +20x[l+1]
+    -5x[l+2] +x[l+3]. `roll(x, k)` must move element l to l+k."""
+    return (roll(x, 2) - 5 * roll(x, 1) + 20 * x + 20 * roll(x, -1)
+            - 5 * roll(x, -2) + roll(x, -3))
+
+
+def _tap6_row(x, roll):
+    return (roll(x, 2) - 5 * roll(x, 1) + 20 * x + 20 * roll(x, -1)
+            - 5 * roll(x, -2) + roll(x, -3))
+
+
+def _halfpel_planes(r32, roll_rows, roll_lanes, out_dtype=None):
+    """(R, B, H, J) planes from an int32 full-pel plane. B = horizontal
+    half (b), H = vertical half (h), J = diagonal (j, from the
+    unrounded horizontal intermediates). Edge lanes/rows hold garbage
+    within the pad halo — callers never slice them. `out_dtype` stores
+    the planes narrower (bf16 holds 0..255 exactly) — halves the
+    kernel's per-center VMEM footprint."""
+    hb1 = _tap6_lane(r32, roll_lanes)
+    b = jnp.clip((hb1 + 16) >> 5, 0, 255)
+    vb1 = _tap6_row(r32, roll_rows)
+    h = jnp.clip((vb1 + 16) >> 5, 0, 255)
+    j1 = _tap6_row(hb1, roll_rows)
+    j = jnp.clip((j1 + 512) >> 10, 0, 255)
+    planes = (r32, b, h, j)
+    if out_dtype is not None:
+        planes = tuple(x.astype(out_dtype) for x in planes)
+    return planes
+
+
+def _chroma_weights(wy: int, wx: int) -> tuple[int, int, int, int]:
+    """Static §8.4.2.2.2 bilinear weights for a half-unit offset from an
+    even-pel center: eighth-pel fracs are (w & 3) * 2."""
+    ey, ex = (wy & 3) * 2, (wx & 3) * 2
+    return ((8 - ex) * (8 - ey), ex * (8 - ey), (8 - ex) * ey, ex * ey)
+
+
+# ---------------------------------------------------------------------------
+# host/XLA-side padding + selector constants
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _geom(H: int, W: int):
+    """Static geometry for a padded frame (H, W multiples of 16).
+
+    The kernel runs on a 2D grid (MB row x 256-lane chunk): every VMEM
+    buffer is chunk-sized, so the footprint is resolution-independent
+    (a frame-wide variant needed ~1 MB of loop-carry stack per class
+    loop and overflowed the 16 MB physical VMEM at 1080p)."""
+    mbh, mbw = H // 16, W // 16
+    WcK = _round_up(W, 256)             # chunked luma width (16 MBs/chunk)
+    nch = WcK // 256                    # grid chunks
+    W2K = WcK + 256                     # wide luma ref lane width
+    WcuK = WcK // 2                     # chroma pred width
+    W2cK = WcuK + 128                   # wide chroma ref lane width
+    return mbh, mbw, WcK, nch, W2K, WcuK, W2cK
+
+
+#: kernel-local (per-chunk) lane widths: two ref lane-blocks each
+_LWY = 512                  # luma: 2 x 256-lane blocks
+_LWC = 256                  # chroma: 2 x 128-lane blocks
+
+
+@functools.lru_cache(maxsize=None)
+def _selector_np():
+    """(256, 128) block-sum selector: lane l -> MB l // 16."""
+    s = np.zeros((256, 128), np.float32)
+    for lane in range(256):
+        s[lane, lane // 16] = 1.0
+    return s
+
+
+@functools.lru_cache(maxsize=None)
+def _expander_np(group: int):
+    """(128, 16 * group) MB -> lane expansion: out[m, l] = 1 iff
+    l // group == m (only the chunk's 16 MBs have lanes)."""
+    e = np.zeros((128, 16 * group), np.float32)
+    for lane in range(16 * group):
+        e[lane // group, lane] = 1.0
+    return e
+
+
+def _pad_luma_wide(p, H, W, W2K):
+    """(H, W) -> (H + 2*_PV + 32, W2K + 128) edge-replicated int16,
+    with 16 extra rows/lanes of low-side margin so a per-center dynamic
+    slice at (16 + cy, 16 + cx) re-anchors the plane (centers are
+    clamped to ±_CLIM = ±12). Centering happens in XLA — the kernel
+    contains no dynamic rotates (Mosaic's dynamic_rotate produced
+    corrupted lanes in composed programs on v5e)."""
+    out = jnp.pad(p, ((_PV + 16, _PV + 16),
+                      (_PH + 16, W2K + 88 - W)), mode="edge")
+    # int32 operands: the layout-canonicalization fusion XLA inserts
+    # for (2,1)-packed int16 custom-call operands corrupts the trailing
+    # sub-tile of each 128-lane tile when the producer is in-program
+    # (observed on v5e); int32 operands take an unpacked path.
+    return out.astype(jnp.int32)
+
+
+def _pad_chroma_wide(p, H, W, W2cK):
+    h2, w2 = H // 2, W // 2
+    out = jnp.pad(p, ((_PVC + 8, _PVC + 16),
+                      (_PHC + 8, W2cK + 104 - w2)), mode="edge")
+    return out.astype(jnp.int32)
+
+
+def _center_stack(wide, starts_r, starts_c, rows, cols):
+    """Stack per-center dynamic slices of a wide padded plane."""
+    return jnp.stack([
+        jax.lax.dynamic_slice(wide, (starts_r[i], starts_c[i]),
+                              (rows, cols))
+        for i in range(3)])
+
+
+def _pad_cur(y, H, W, WcK):
+    if WcK == W:
+        return y.astype(jnp.int32)
+    return jnp.pad(y, ((0, 0), (0, WcK - W)), mode="edge").astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# the kernel
+# ---------------------------------------------------------------------------
+
+def _me_kernel(H: int, W: int):
+    mbh, mbw, WcK, nch, W2K, WcuK, W2cK = _geom(H, W)
+
+    def kernel(cent_ref,
+               cur_ref,
+               ry00, ry10, ry20, ry01, ry11, ry21,
+               ru00, ru10, ru20, ru01, ru11, ru21,
+               rv00, rv10, rv20, rv01, rv11, rv21,
+               s_ref, sty_ref, stc_ref, _dmv, _dpy, _dpu, _dpv,
+               mv_ref, py_ref, pu_ref, pv_ref):
+        # Inputs arrive PRE-CENTERED per search center (leading dim 3,
+        # XLA-side dynamic slice of a wide pad): no dynamic rotates in
+        # the kernel; all remaining rolls have CONSTANT shifts. The 48
+        # rows x 512 lanes are exactly the 6-tap reach of this chunk's
+        # windows.
+        R3 = jnp.concatenate([
+            jnp.concatenate([ry00[:], ry10[:], ry20[:]], axis=1),
+            jnp.concatenate([ry01[:], ry11[:], ry21[:]], axis=1),
+        ], axis=2)                                        # (3, 48, 512)
+        CU3 = jnp.concatenate([
+            jnp.concatenate([ru00[:], ru10[:], ru20[:]], axis=1),
+            jnp.concatenate([ru01[:], ru11[:], ru21[:]], axis=1),
+        ], axis=2)                                        # (3, 24, 256)
+        CV3 = jnp.concatenate([
+            jnp.concatenate([rv00[:], rv10[:], rv20[:]], axis=1),
+            jnp.concatenate([rv01[:], rv11[:], rv21[:]], axis=1),
+        ], axis=2)
+        cur = cur_ref[:].astype(jnp.bfloat16)             # (16, 256)
+        S = s_ref[:]                                      # (256, 128) bf16
+        STy = sty_ref[:]                                  # (128, 256) bf16
+        STc = stc_ref[:]                                  # (128, 128) bf16
+        lam = cent_ref[0, 6].astype(jnp.float32)
+
+        # constant-shift rolls only; negative shifts wrap mod the size
+        roll_rows = lambda x, k: pltpu.roll(x, k % x.shape[0], axis=0)
+        roll_lanes = lambda x, k: pltpu.roll(x, k % x.shape[1], axis=1)
+
+        def roll01_rows(x, flag):
+            """Roll rows by a traced 0/1 without a dynamic rotate."""
+            return jnp.where(flag > 0, roll_rows(x, -1), x)
+
+        def roll01_lanes(x, flag):
+            return jnp.where(flag > 0, roll_lanes(x, -1), x)
+
+        bestc = jnp.full((1, 128), 2.0**30, jnp.float32)
+        bmy = jnp.zeros((1, 128), jnp.int32)
+        bmx = jnp.zeros((1, 128), jnp.int32)
+        py = jnp.zeros((16, 256), jnp.bfloat16)
+        pu = jnp.zeros((8, 128), jnp.int16)
+        pv = jnp.zeros((8, 128), jnp.int16)
+        state = (bestc, bmy, bmx, py, pu, pv)
+
+        # lane bases inside the 512/256-wide local planes: orig sample
+        # q of this chunk sits at luma lane _PH + q, chroma _PHC/2 + q
+        _LBY = _PH                       # 24
+        _LBC = _PHC                      # 16
+
+        def offset_body(state, Lr, Cu9, Cv9, wy, wx, cy, cx):
+            """One candidate: Lr is 16 rows of the class plane, rolled
+            so the candidate occupies lanes [_LBY, _LBY+256); Cu9/Cv9
+            are 9 chroma rows rolled likewise. wy/wx traced."""
+            bestc, bmy, bmx, py, pu, pv = state
+            cand = jax.lax.slice(Lr, (0, _LBY), (16, _LBY + 256)
+                                 ).astype(jnp.bfloat16)
+            ad = jnp.abs(cur - cand)
+            sad2 = jnp.dot(ad, S, preferred_element_type=jnp.float32)
+            sadv = jnp.sum(sad2, axis=0, keepdims=True)   # (1, 128)
+            mvy = 2 * cy + wy
+            mvx = 2 * cx + wx
+            cost = sadv + lam * (
+                jnp.abs(mvy) + jnp.abs(mvx)).astype(jnp.float32)
+            take = cost < bestc                           # (1, 128) bool
+            bestc = jnp.where(take, cost, bestc)
+            bmy = jnp.where(take, mvy, bmy)
+            bmx = jnp.where(take, mvx, bmx)
+            # Per-MB -> per-lane mask expansion as an exact matmul with
+            # the selector transpose (0/1 in bf16). pltpu.repeat is a
+            # TILE repeat ([abc] -> [abcabc]), not the element repeat
+            # ([abc] -> [aabbcc]) this needs — using it here corrupted
+            # every macroblock whose neighbors chose different
+            # candidates.
+            tif = take.astype(jnp.bfloat16)
+            tly = jnp.dot(tif, STy, preferred_element_type=jnp.float32)
+            py = jnp.where(jnp.broadcast_to(tly > 0.5, (16, 256)), cand,
+                           py)
+
+            # §8.4.2.2.2 bilinear, eighth-pel fracs (w & 3) * 2 (traced;
+            # exact for frac 0: (64 * a + 32) >> 6 == a).
+            ey = (wy & 3) * 2
+            ex = (wx & 3) * 2
+
+            def cpred(C9):
+                a = jax.lax.slice(C9, (0, _LBC), (8, _LBC + 128))
+                b = jax.lax.slice(C9, (0, _LBC + 1), (8, _LBC + 129))
+                c = jax.lax.slice(C9, (1, _LBC), (9, _LBC + 128))
+                d = jax.lax.slice(C9, (1, _LBC + 1), (9, _LBC + 129))
+                out = ((8 - ex) * (8 - ey) * a + ex * (8 - ey) * b
+                       + (8 - ex) * ey * c + ex * ey * d + 32) >> 6
+                return out.astype(jnp.int16)
+
+            tlc = jnp.dot(tif, STc, preferred_element_type=jnp.float32)
+            mc = jnp.broadcast_to(tlc > 0.5, (8, 128))
+            pu = jnp.where(mc, cpred(Cu9), pu)
+            pv = jnp.where(mc, cpred(Cv9), pv)
+            return (bestc, bmy, bmx, py, pu, pv)
+
+        def class_scan(plane, CUc, CVc, cy, cx, wys, wxs, state):
+            """Walk one parity class's (wys x wxs) grid. The plane and
+            chroma planes are pre-rolled to the first offset; each
+            fori_loop step rolls by the grid's one-sample stride, so
+            every candidate is a static slice and the loop carries are
+            chunk-sized."""
+            ny, nx = len(wys), len(wxs)
+            wy0, wx0 = wys[0], wxs[0]
+            Pl = roll_rows(plane, -(wy0 >> 1))
+            Cur = roll_rows(CUc, -(wy0 >> 2))
+            Cvr = roll_rows(CVc, -(wy0 >> 2))
+
+            def outer(iy, carry):
+                Pl, Cur, Cvr, state = carry
+                wy = wy0 + 2 * iy
+                Lr = jax.lax.slice(Pl, (_KPV, 0), (_KPV + 16, _LWY))
+                Lr = roll_lanes(Lr, -(wx0 >> 1))
+                Cu9 = roll_lanes(
+                    jax.lax.slice(Cur, (_KPVC, 0), (_KPVC + 9, _LWC)),
+                    -(wx0 >> 2))
+                Cv9 = roll_lanes(
+                    jax.lax.slice(Cvr, (_KPVC, 0), (_KPVC + 9, _LWC)),
+                    -(wx0 >> 2))
+
+                def inner(ix, icarry):
+                    Lr, Cu9, Cv9, state = icarry
+                    wx = wx0 + 2 * ix
+                    state = offset_body(state, Lr, Cu9, Cv9, wy, wx,
+                                        cy, cx)
+                    cd = ((wx + 2) >> 2) - (wx >> 2)
+                    return (roll_lanes(Lr, -1), roll01_lanes(Cu9, cd),
+                            roll01_lanes(Cv9, cd), state)
+
+                _, _, _, state = jax.lax.fori_loop(
+                    0, nx, inner, (Lr, Cu9, Cv9, state))
+                rd = ((wy + 2) >> 2) - (wy >> 2)
+                return (roll_rows(Pl, -1), roll01_rows(Cur, rd),
+                        roll01_rows(Cvr, rd), state)
+
+            _, _, _, state = jax.lax.fori_loop(
+                0, ny, outer, (Pl, Cur, Cvr, state))
+            return state
+
+        def run_center(ci, classes, state):
+            cy = cent_ref[0, 2 * ci]
+            cx = cent_ref[0, 2 * ci + 1]
+            # Interpolation planes built DIRECTLY at the 32 rows the
+            # windows slice (row base _KPV); vertical 6-taps as static
+            # row slices — no full-height temporaries.
+            RcT = R3[ci].astype(jnp.int32)                # (48, 512)
+
+            def vtap(x, r0):
+                W_ = x.shape[1]
+                return (jax.lax.slice(x, (r0 - 2, 0), (r0 + 30, W_))
+                        - 5 * jax.lax.slice(x, (r0 - 1, 0), (r0 + 31, W_))
+                        + 20 * jax.lax.slice(x, (r0, 0), (r0 + 32, W_))
+                        + 20 * jax.lax.slice(x, (r0 + 1, 0), (r0 + 33, W_))
+                        - 5 * jax.lax.slice(x, (r0 + 2, 0), (r0 + 34, W_))
+                        + jax.lax.slice(x, (r0 + 3, 0), (r0 + 35, W_)))
+
+            hb1 = _tap6_lane(jax.lax.slice(RcT, (5, 0), (43, _LWY)),
+                             roll_lanes)                  # rows [5, 43)
+            p0 = jax.lax.slice(RcT, (8, 0), (40, _LWY)).astype(jnp.float32)
+            b = jnp.clip((jax.lax.slice(hb1, (3, 0), (35, _LWY)) + 16)
+                         >> 5, 0, 255).astype(jnp.float32)
+            h = jnp.clip((vtap(RcT, 8) + 16) >> 5, 0, 255
+                         ).astype(jnp.float32)
+            # j: vertical 6-tap of the unrounded horizontal
+            # intermediates; hb1 row r holds RcT row r + 5
+            j = jnp.clip((vtap(hb1, 3) + 512) >> 10, 0, 255
+                         ).astype(jnp.float32)
+            planes = (p0, b, h, j)
+            CUc = CU3[ci].astype(jnp.int32)               # (24, 256)
+            CVc = CV3[ci].astype(jnp.int32)
+            for (par, wys, wxs) in classes:
+                plane = planes[par[0] * 2 + par[1]]
+                state = class_scan(plane, CUc, CVc, cy, cx, wys, wxs,
+                                   state)
+            return state
+
+        state = run_center(0, CENTER_CLASSES, state)
+        state = run_center(1, CENTER_CLASSES, state)
+        state = run_center(2, ZERO_CLASSES, state)
+        bestc, bmy, bmx, py, pu, pv = state
+
+        mv_ref[0, 0, 0:1, :] = bmy
+        mv_ref[0, 0, 1:2, :] = bmx
+        mv_ref[0, 0, 2:3, :] = bestc.astype(jnp.int32)
+        mv_ref[0, 0, 3:8, :] = jnp.zeros((5, 128), jnp.int32)
+        py_ref[:] = py.astype(jnp.int32)
+        pu_ref[:] = pu.astype(jnp.int32)
+        pv_ref[:] = pv.astype(jnp.int32)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("H", "W", "interpret"))
+def _me_pallas(cent, cur, refy, refu, refv, sel, sty, stc, *, H: int,
+               W: int, interpret: bool):
+    mbh, mbw, WcK, nch, W2K, WcuK, W2cK = _geom(H, W)
+    vspec = lambda shape, imap: pl.BlockSpec(shape, imap,
+                                             memory_space=pltpu.VMEM)
+    in_specs = [
+        pl.BlockSpec((1, 8), lambda i, c: (0, 0), memory_space=pltpu.SMEM),
+        vspec((16, 256), lambda i, c: (i, c)),
+    ]
+    # luma ref: 3 row-blocks x 2 lane-blocks, overlapping windows via
+    # the multi-input trick (index maps may not overlap within a spec)
+    for kl in range(2):
+        for k in range(1, 4):
+            in_specs.append(vspec((3, 16, 256), functools.partial(
+                lambda i, c, k=0, kl=0: (0, i + k, c + kl), k=k, kl=kl)))
+    for plane in range(2):
+        for kl in range(2):
+            for k in range(1, 4):
+                in_specs.append(vspec((3, 8, 128), functools.partial(
+                    lambda i, c, k=0, kl=0: (0, i + k, c + kl),
+                    k=k, kl=kl)))
+    in_specs.append(vspec((256, 128), lambda i, c: (0, 0)))
+    in_specs.append(vspec((128, 256), lambda i, c: (0, 0)))
+    in_specs.append(vspec((128, 128), lambda i, c: (0, 0)))
+
+    out_shape = (
+        jax.ShapeDtypeStruct((mbh, nch, 8, 128), jnp.int32),
+        jax.ShapeDtypeStruct((H, WcK), jnp.int32),
+        jax.ShapeDtypeStruct((H // 2, WcuK), jnp.int32),
+        jax.ShapeDtypeStruct((H // 2, WcuK), jnp.int32),
+    )
+    out_specs = (
+        pl.BlockSpec((1, 1, 8, 128), lambda i, c: (i, c, 0, 0),
+                     memory_space=pltpu.VMEM),
+        vspec((16, 256), lambda i, c: (i, c)),
+        vspec((8, 128), lambda i, c: (i, c)),
+        vspec((8, 128), lambda i, c: (i, c)),
+    )
+    # Output buffers are pre-allocated as aliased dummy INPUTS: the
+    # kernel reads overlapping reference windows across grid steps, so
+    # its outputs must never share memory with its (dead-after-call)
+    # ref operands — the aliased dummies' live ranges overlap every
+    # operand's, forcing disjoint allocations. Data-dependent (not
+    # constants) so XLA cannot CSE them.
+    z32 = (cur[0, 0] * 0).astype(jnp.int32)
+    dummies = (
+        jnp.zeros((mbh, nch, 8, 128), jnp.int32) + z32,
+        jnp.zeros((H, WcK), jnp.int32) + z32,
+        jnp.zeros((H // 2, WcuK), jnp.int32) + z32,
+        jnp.zeros((H // 2, WcuK), jnp.int32) + z32,
+    )
+    in_specs += list(out_specs)
+    n_in = 23
+    return pl.pallas_call(
+        _me_kernel(H, W),
+        grid=(mbh, nch),
+        out_shape=out_shape,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        interpret=interpret,
+        input_output_aliases={n_in + i: i for i in range(4)},
+    )(cent, cur,
+      *[refy] * 6, *[refu] * 6, *[refv] * 6, sel, sty, stc, *dummies)
+
+
+# ---------------------------------------------------------------------------
+# XLA reference implementation (identical semantics; CPU/conformance)
+# ---------------------------------------------------------------------------
+
+def me_search_xla(cur_y, ref_y, ref_u, ref_v, centers, lam):
+    """Pure-XLA mirror of the kernel: same OFFSET_TABLE, same strict-<
+    selection, same interpolation — the executable spec the Pallas
+    kernel is tested against, and the off-TPU path. Structured as a
+    `fori_loop` over a device-side offset table (a fully unrolled graph
+    compiles super-linearly on XLA CPU — measured minutes at 267
+    offsets). cur_y int16 (H, W); ref planes int16; centers (3, 2)
+    int32 even-pel. Returns (mv (mbh, mbw, 2) int32 half-pel, pred_y,
+    pred_u, pred_v int16)."""
+    H, W = cur_y.shape
+    mbh, mbw = H // 16, W // 16
+    cur = cur_y.astype(jnp.int32)
+    ry = jnp.pad(ref_y, ((_PV, _PV), (_PH, _PH)),
+                 mode="edge").astype(jnp.int32)
+    ru = jnp.pad(ref_u, ((_PVC, _PVC + 8), (_PHC, _PHC + 8)),
+                 mode="edge").astype(jnp.int32)
+    rv = jnp.pad(ref_v, ((_PVC, _PVC + 8), (_PHC, _PHC + 8)),
+                 mode="edge").astype(jnp.int32)
+    roll_rows = lambda x, k: jnp.roll(x, k, axis=0)
+    roll_lanes = lambda x, k: jnp.roll(x, k, axis=1)
+
+    zero = (cur_y.reshape(-1)[0] * 0).astype(jnp.int32)
+    bestc = jnp.full((mbh, mbw), 2**30, jnp.int32) + zero
+    bmy = jnp.zeros((mbh, mbw), jnp.int32) + zero
+    bmx = jnp.zeros((mbh, mbw), jnp.int32) + zero
+    py = jnp.zeros((H, W), jnp.int32) + zero
+    pu = jnp.zeros((H // 2, W // 2), jnp.int32) + zero
+    pv = jnp.zeros((H // 2, W // 2), jnp.int32) + zero
+
+    def mb_sad(ad):
+        return ad.reshape(mbh, 16, mbw, 16).sum((1, 3))
+
+    # Per-center static setup (3 centers), dynamic loop over offsets.
+    for ci in range(3):
+        cy, cx = centers[ci, 0], centers[ci, 1]
+        Rc = roll_lanes(roll_rows(ry, -cy), -cx)
+        planes = jnp.stack(_halfpel_planes(Rc, roll_rows, roll_lanes))
+        CUc = roll_lanes(roll_rows(ru, -(cy >> 1)), -(cx >> 1))
+        CVc = roll_lanes(roll_rows(rv, -(cy >> 1)), -(cx >> 1))
+        offs = jnp.asarray([(wy, wx) for (c, wy, wx) in OFFSET_TABLE
+                            if c == ci], jnp.int32)
+
+        def body(i, state, planes=planes, CUc=CUc, CVc=CVc, offs=offs,
+                 cy=cy, cx=cx):
+            bestc, bmy, bmx, py, pu, pv = state
+            wy, wx = offs[i, 0], offs[i, 1]
+            my, mx = wy >> 1, wx >> 1
+            plane = planes[(wy & 1) * 2 + (wx & 1)]
+            cand = jax.lax.dynamic_slice(plane, (_PV + my, _PH + mx),
+                                         (H, W))
+            sad = mb_sad(jnp.abs(cur - cand))
+            mvy = 2 * cy + wy
+            mvx = 2 * cx + wx
+            cost = sad + lam * (jnp.abs(mvy) + jnp.abs(mvx))
+            take = cost < bestc
+            bestc = jnp.where(take, cost, bestc)
+            bmy = jnp.where(take, mvy, bmy)
+            bmx = jnp.where(take, mvx, bmx)
+            tly = jnp.broadcast_to(take[:, None, :, None],
+                                   (mbh, 16, mbw, 16)).reshape(H, W)
+            py = jnp.where(tly, cand, py)
+            # §8.4.2.2.2 bilinear; weights (8-ex)(8-ey) etc. with
+            # eighth-pel fracs (w & 3) * 2 — exact for frac 0 too.
+            ey = (wy & 3) * 2
+            ex = (wx & 3) * 2
+            oy, ox = wy >> 2, wx >> 2
+
+            def cpred(C):
+                h2, w2 = H // 2, W // 2
+                a = jax.lax.dynamic_slice(C, (_PVC + oy, _PHC + ox),
+                                          (h2, w2))
+                b = jax.lax.dynamic_slice(C, (_PVC + oy, _PHC + ox + 1),
+                                          (h2, w2))
+                c = jax.lax.dynamic_slice(C, (_PVC + oy + 1, _PHC + ox),
+                                          (h2, w2))
+                d = jax.lax.dynamic_slice(
+                    C, (_PVC + oy + 1, _PHC + ox + 1), (h2, w2))
+                return ((8 - ex) * (8 - ey) * a + ex * (8 - ey) * b
+                        + (8 - ex) * ey * c + ex * ey * d + 32) >> 6
+
+            tlc = jnp.broadcast_to(take[:, None, :, None],
+                                   (mbh, 8, mbw, 8)).reshape(H // 2,
+                                                             W // 2)
+            pu = jnp.where(tlc, cpred(CUc), pu)
+            pv = jnp.where(tlc, cpred(CVc), pv)
+            return (bestc, bmy, bmx, py, pu, pv)
+
+        bestc, bmy, bmx, py, pu, pv = jax.lax.fori_loop(
+            0, offs.shape[0], body, (bestc, bmy, bmx, py, pu, pv))
+
+    mv = jnp.stack([bmy, bmx], axis=-1)
+    return (mv, py.astype(jnp.int16), pu.astype(jnp.int16),
+            pv.astype(jnp.int16))
+
+
+# ---------------------------------------------------------------------------
+# centers: coarse global-motion probe + carried median, both batched
+# ---------------------------------------------------------------------------
+
+_COARSE = 4
+
+
+def _box_sum(x, s: int):
+    H, W = x.shape
+    return x.reshape(H // s, s, W // s, s).sum((1, 3), dtype=jnp.int32)
+
+
+def coarse_probe(cur16, ref16, sr: int = SEARCH_RANGE):
+    """Global-motion probe on box-summed quarter-res planes; batched
+    static slices (the r4 fori_loop version was launch-bound). Returns
+    a (2,) int32 center in pel, multiple of _COARSE (hence even)."""
+    qs = _COARSE
+    cq = _box_sum(cur16, qs)
+    rq = _box_sum(ref16, qs)
+    qsr = sr // qs
+    rq_pad = jnp.pad(rq, qsr, mode="edge")
+    qh, qw = cq.shape
+    n = 2 * qsr + 1
+    wins = jnp.stack([jax.lax.slice(rq_pad, (oy, ox), (oy + qh, ox + qw))
+                      for oy in range(n) for ox in range(n)])
+    cost = jnp.abs(cq[None] - wins).sum((1, 2))
+    bi = jnp.argmin(cost).astype(jnp.int32)
+    return jnp.stack([bi // n - qsr, bi % n - qsr]) * qs
+
+
+def hist_median(mv_flat, lim: int):
+    """Per-component median of an (n, 2) int field via histogram +
+    cumsum (jnp.median sorts — measured ~4 ms on TPU for 8K MBs)."""
+    n = mv_flat.shape[0]
+    bins = jnp.arange(-lim, lim + 1)
+    cnt = (mv_flat[:, None, :] == bins[None, :, None]).sum(0)
+    cum = jnp.cumsum(cnt, axis=0)
+    return ((cum >= (n + 1) // 2).argmax(axis=0) - lim).astype(jnp.int32)
+
+
+def centers_from(cur16, ref16, pred_mv_h):
+    """(3, 2) even-pel centers: probe, carried-median, zero.
+    pred_mv_h is the previous frame's median MV in half units."""
+    probe = coarse_probe(cur16, ref16)
+    med_pel = jnp.clip((pred_mv_h + 2) >> 2, -(_CLIM // 2),
+                       _CLIM // 2) * 2        # nearest even pel, clamped
+    probe = jnp.clip(probe, -_CLIM, _CLIM)
+    zero = jnp.zeros(2, jnp.int32) + (cur16.reshape(-1)[0] * 0).astype(
+        jnp.int32)
+    return jnp.stack([probe, med_pel, zero])
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+def use_pallas() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def me_search_pallas(cur_y16, ref_y16, ref_u16, ref_v16, centers, lam,
+                     interpret: bool = False):
+    """Kernel path: prep (pad + per-center dynamic slices — the kernel
+    contains no dynamic shifts) + the Pallas call. `interpret=True`
+    runs the kernel in the Pallas interpreter — the CPU parity test
+    against `me_search_xla` (tests/test_jaxme.py) exercises exactly the
+    production kernel code path."""
+    H, W = cur_y16.shape
+    mbh, mbw, WcK, nch, W2K, WcuK, W2cK = _geom(H, W)
+    cent = jnp.concatenate(
+        [centers[:2].reshape(-1), jnp.zeros(2, jnp.int32),
+         lam.reshape(1), jnp.zeros(1, jnp.int32)]).reshape(1, 8)
+    cur = _pad_cur(cur_y16, H, W, WcK)
+    wy_ = _pad_luma_wide(ref_y16, H, W, W2K)
+    wu_ = _pad_chroma_wide(ref_u16, H, W, W2cK)
+    wv_ = _pad_chroma_wide(ref_v16, H, W, W2cK)
+    cys = [16 + centers[i, 0] for i in range(3)]
+    cxs = [16 + centers[i, 1] for i in range(3)]
+    refy = _center_stack(wy_, cys, cxs, H + 2 * _PV, W2K)
+    ccys = [8 + (centers[i, 0] >> 1) for i in range(3)]
+    ccxs = [8 + (centers[i, 1] >> 1) for i in range(3)]
+    refu = _center_stack(wu_, ccys, ccxs, H // 2 + 40, W2cK)
+    refv = _center_stack(wv_, ccys, ccxs, H // 2 + 40, W2cK)
+    sel = jnp.asarray(_selector_np(), jnp.bfloat16)
+    sty = jnp.asarray(_expander_np(16), jnp.bfloat16)
+    stc = jnp.asarray(_expander_np(8), jnp.bfloat16)
+    mvo, py, pu, pv = _me_pallas(cent, cur, refy, refu, refv, sel,
+                                 sty, stc, H=H, W=W, interpret=interpret)
+    # (mbh, nch, 8, 128): rows 0/1 = bmy/bmx, 16 MBs per chunk
+    bmy = mvo[:, :, 0, :16].reshape(mbh, nch * 16)[:, :mbw]
+    bmx = mvo[:, :, 1, :16].reshape(mbh, nch * 16)[:, :mbw]
+    mv = jnp.stack([bmy, bmx], axis=-1)
+    return (mv, py[:, :W].astype(jnp.int16),
+            pu[:, :W // 2].astype(jnp.int16),
+            pv[:, :W // 2].astype(jnp.int16))
+
+
+def me_search(cur_y16, ref_y16, ref_u16, ref_v16, pred_mv_h, qp):
+    """Full ME+MC for one P frame. Inputs int16 planes (H, W multiples
+    of 16); pred_mv_h (2,) int32 half-pel (previous frame's median);
+    qp the frame's quantizer (drives the MV-cost lambda).
+    Returns (mv (mbh, mbw, 2) int32 half-pel, pred_y, pred_u, pred_v
+    int16, med_mv_h (2,) int32)."""
+    centers = centers_from(cur_y16, ref_y16, pred_mv_h)
+    lam = jnp.asarray(LAMBDA_H)[jnp.clip(qp, 0, 51)]
+    if use_pallas():
+        mv, pred_y, pred_u, pred_v = me_search_pallas(
+            cur_y16, ref_y16, ref_u16, ref_v16, centers, lam)
+    else:
+        mv, pred_y, pred_u, pred_v = me_search_xla(
+            cur_y16, ref_y16, ref_u16, ref_v16, centers, lam)
+    med = hist_median(mv.reshape(-1, 2), 2 * SEARCH_RANGE)
+    return mv, pred_y, pred_u, pred_v, med

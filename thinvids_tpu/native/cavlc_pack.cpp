@@ -343,7 +343,7 @@ static void compute_mv_pred(const int32_t* mv, int mbw, int mbh,
 }
 
 // Packs one P picture (all-inter, P_L0_16x16 / P_Skip, single reference,
-// integer-pel MVs). mv: nmb*2 as (dy, dx); luma16: nmb*16*16 z-scan blocks
+// half-pel MVs). mv: nmb*2 as (dy, dx); luma16: nmb*16*16 z-scan blocks
 // of 16 zig-zag coeffs. Mirrors codecs/h264/inter.pack_p_slice bit-for-bit.
 int64_t cavlc_pack_pslice(
     const uint8_t* header_bytes, int32_t header_bit_len,
@@ -409,9 +409,10 @@ int64_t cavlc_pack_pslice(
       bw.ue(skip_run);
       skip_run = 0;
       bw.ue(0);   // mb_type = P_L0_16x16
-      // mvd: horizontal first (§7.3.5.1); layout is (dy, dx), quarter-pel.
-      bw.se(4 * (mv[(size_t)mi * 2 + 1] - mvp[(size_t)mi * 2 + 1]));
-      bw.se(4 * (mv[(size_t)mi * 2] - mvp[(size_t)mi * 2]));
+      // mvd: horizontal first (§7.3.5.1); layout is (dy, dx). mv is in
+      // half-pel units, mvd is coded in quarter-pel units.
+      bw.se(2 * (mv[(size_t)mi * 2 + 1] - mvp[(size_t)mi * 2 + 1]));
+      bw.se(2 * (mv[(size_t)mi * 2] - mvp[(size_t)mi * 2]));
       bw.ue((uint32_t)g_cbp_inter[cbp]);
       if (cbp) bw.se(0);   // mb_qp_delta
 
@@ -572,8 +573,9 @@ int64_t cavlc_pack_pslice_plane_impl(
       bw.ue(skip_run);
       skip_run = 0;
       bw.ue(0);   // mb_type = P_L0_16x16
-      bw.se(4 * (mv[(size_t)mi * 2 + 1] - mvp[(size_t)mi * 2 + 1]));
-      bw.se(4 * (mv[(size_t)mi * 2] - mvp[(size_t)mi * 2]));
+      // mv half-pel -> mvd quarter-pel (see above).
+      bw.se(2 * (mv[(size_t)mi * 2 + 1] - mvp[(size_t)mi * 2 + 1]));
+      bw.se(2 * (mv[(size_t)mi * 2] - mvp[(size_t)mi * 2]));
       bw.ue((uint32_t)g_cbp_inter[cbp]);
       if (cbp) bw.se(0);   // mb_qp_delta
 
