@@ -181,8 +181,8 @@ class TestShardedInterDispatch:
         assert got == b"".join(parts)
 
     def test_block_sparse2_roundtrip(self):
-        # two-tier device pack <-> host unpack over mixed content incl.
-        # escapes (|v| > 127) and a non-multiple-of-16 length
+        # two-tier device pack <-> host unpack over clustered content
+        # and a non-multiple-of-16 length
         from thinvids_tpu.codecs.h264 import jaxcore
         import jax.numpy as jnp
 
@@ -194,15 +194,29 @@ class TestShardedInterDispatch:
         hot_blocks = rng.choice(200, 120, replace=False)
         for b in hot_blocks:
             lanes = rng.choice(16, rng.integers(1, 6), replace=False)
-            flat[b * 16 + lanes] = rng.integers(-300, 301, len(lanes))
+            flat[b * 16 + lanes] = rng.integers(-120, 121, len(lanes))
         out = jaxcore._block_sparse_pack2(jnp.asarray(flat))
-        nblk, nval, n_esc, bitmap, bmask16, vals, esc_pos, esc_val = \
+        nblk, nval, n_esc, bitmap, bmask16, vals = \
             [np.asarray(x) for x in out]
         assert jaxcore.block_sparse2_fits(nblk, nval, n_esc, L)
         back = jaxcore._block_sparse_unpack2(
-            int(nblk), int(nval), int(n_esc), bitmap, bmask16, vals,
-            esc_pos, esc_val, L)
+            int(nblk), int(nval), bitmap, bmask16, vals, L)
         np.testing.assert_array_equal(back, flat.astype(np.int16))
+
+    def test_block_sparse2_escape_forces_dense(self):
+        # |level| > 127 has no escape side-channel anymore: the pack
+        # reports a count and the caller must take the dense fallback
+        from thinvids_tpu.codecs.h264 import jaxcore
+        import jax.numpy as jnp
+
+        L = 16 * 64
+        flat = np.zeros(L, np.int32)
+        flat[3] = 300
+        nblk, nval, n_esc, *_ = [
+            np.asarray(x) for x in
+            jaxcore._block_sparse_pack2(jnp.asarray(flat))]
+        assert int(n_esc) == 1
+        assert not jaxcore.block_sparse2_fits(nblk, nval, n_esc, L)
 
     def test_sharded_gop_odd_mb_count(self):
         # 80x48 -> 5x3 = 15 MBs (odd): the GOP flat level vector length

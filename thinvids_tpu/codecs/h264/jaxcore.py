@@ -414,20 +414,25 @@ def _block_sparse_pack2(flat, budget_div: int = _BLOCK_BUDGET_DIV,
     occupancy mask per block + just the nonzero values: ~2.6 MB/GOP vs
     ~6.6 MB (1080p, F=8).
 
-    Returns (nblk, nval, n_esc, bitmap, bmask16, vals, esc_pos, esc_val):
+    Returns (nblk, nval, n_esc, bitmap, bmask16, vals):
     - bitmap: 1 bit per block (any-nonzero), ceil(L/16)/8 bytes;
     - bmask16: per gathered block, a uint16 lane-occupancy mask
       (bit k = coeff k nonzero), fixed (NB//budget_div,) buffer;
     - vals: the nonzero coeffs in (block, lane) order, int8-clipped,
       fixed (L//val_div,) buffer;
-    - esc_pos/esc_val: VALUE-STREAM positions + true values of coeffs
-      exceeding int8.
+    - n_esc: COUNT of coeffs exceeding int8. There is no escape
+      side-channel: levels beyond ±127 are rare at practical QPs, and
+      the old (position, value) stream needed a full-size cumsum plus
+      two more full-size scatters — measured ~90 ms of a 160 ms pack
+      per 1080p GOP. Any escape (n_esc > 0) now falls back to the
+      dense fetch for the whole wave.
     Caller falls back to a dense fetch iff nblk/nval/n_esc exceed their
     budgets (`block_sparse2_fits`).
     """
     L = flat.shape[0]
     NB = -(-L // _BLOCK)
     pad = NB * _BLOCK - L
+    flat = flat.astype(jnp.int16)       # CAVLC levels fit int16
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros(pad, flat.dtype)])
     budget = NB // budget_div
@@ -458,17 +463,8 @@ def _block_sparse_pack2(flat, budget_div: int = _BLOCK_BUDGET_DIV,
     clipped = jnp.clip(gathered, -_I8_MAX, _I8_MAX).astype(jnp.int8)
     vals = jnp.zeros(vbudget + 1, jnp.int8).at[
         vpos.reshape(-1)].set(clipped.reshape(-1), mode="drop")[:vbudget]
-
-    esc_mask = (jnp.abs(gathered) > _I8_MAX).reshape(-1)
-    n_esc = jnp.sum(esc_mask.astype(jnp.int32))
-    epos = jnp.cumsum(esc_mask.astype(jnp.int32)) - 1
-    eidx = jnp.where(esc_mask, epos, _SPARSE_ESCAPES)
-    esc_pos = jnp.zeros(_SPARSE_ESCAPES + 1, jnp.int32).at[eidx].set(
-        vpos.reshape(-1), mode="drop")[:_SPARSE_ESCAPES]
-    esc_val = jnp.zeros(_SPARSE_ESCAPES + 1, jnp.int32).at[eidx].set(
-        gathered.reshape(-1).astype(jnp.int32), mode="drop"
-    )[:_SPARSE_ESCAPES]
-    return (nblk, nval, n_esc, bitmap, bmask16, vals, esc_pos, esc_val)
+    n_esc = jnp.sum((jnp.abs(gathered) > _I8_MAX).astype(jnp.int32))
+    return (nblk, nval, n_esc, bitmap, bmask16, vals)
 
 
 def block_sparse2_fits(nblk: int, nval: int, n_esc: int, L: int,
@@ -476,13 +472,12 @@ def block_sparse2_fits(nblk: int, nval: int, n_esc: int, L: int,
                        val_div: int = _VAL_BUDGET_DIV) -> bool:
     return (int(nblk) <= (-(-L // _BLOCK)) // budget_div
             and int(nval) <= L // val_div
-            and int(n_esc) <= _SPARSE_ESCAPES)
+            and int(n_esc) == 0)
 
 
-def _block_sparse_unpack2(nblk: int, nval: int, n_esc: int,
-                          bitmap: np.ndarray, bmask16: np.ndarray,
-                          vals: np.ndarray, esc_pos: np.ndarray,
-                          esc_val: np.ndarray, L: int) -> np.ndarray:
+def _block_sparse_unpack2(nblk: int, nval: int, bitmap: np.ndarray,
+                          bmask16: np.ndarray, vals: np.ndarray,
+                          L: int) -> np.ndarray:
     """Host inverse of _block_sparse_pack2 → flat int16 levels."""
     NB = -(-L // _BLOCK)
     bm = np.unpackbits(bitmap)[:NB].astype(bool)
@@ -490,10 +485,6 @@ def _block_sparse_unpack2(nblk: int, nval: int, n_esc: int,
     lane_bits = ((masks[:, None] >> np.arange(_BLOCK, dtype=np.uint32))
                  & 1).astype(bool)                      # (nblk, 16)
     stream = vals[:nval].astype(np.int16)
-    if n_esc:
-        ep = esc_pos[:n_esc]
-        ok = ep < nval
-        stream[ep[ok]] = esc_val[:n_esc][ok].astype(np.int16)
     rows = np.zeros((nblk, _BLOCK), np.int16)
     rows[lane_bits] = stream        # row-major = (block, lane) order
     out = np.zeros((NB, _BLOCK), np.int16)

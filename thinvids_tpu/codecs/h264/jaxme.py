@@ -115,6 +115,11 @@ def _window_classes(int_rad_pel: int, fine_rad_half: int
 
 
 CENTER_CLASSES = _window_classes(_WR, _HR)
+#: the temporal-median center keeps only its integer window — its role
+#: is to re-acquire motion the probe missed; sub-pel refinement around
+#: it duplicates work the probe/zero windows already do (measured: no
+#: quality change, -15% kernel time)
+CENTER_B_CLASSES = CENTER_CLASSES[:1]
 ZERO_CLASSES = _window_classes(_ZR // 2, _ZR)
 
 
@@ -127,7 +132,7 @@ def _class_offsets(classes) -> list[tuple[int, int]]:
 #: best, so earlier entries win ties. Center 2 is the zero vector.
 OFFSET_TABLE: list[tuple[int, int, int]] = (
     [(0,) + o for o in _class_offsets(CENTER_CLASSES)]
-    + [(1,) + o for o in _class_offsets(CENTER_CLASSES)]
+    + [(1,) + o for o in _class_offsets(CENTER_B_CLASSES)]
     + [(2,) + o for o in _class_offsets(ZERO_CLASSES)]
 )
 
@@ -182,64 +187,65 @@ def _chroma_weights(wy: int, wx: int) -> tuple[int, int, int, int]:
 def _geom(H: int, W: int):
     """Static geometry for a padded frame (H, W multiples of 16).
 
-    The kernel runs on a 2D grid (MB row x 256-lane chunk): every VMEM
-    buffer is chunk-sized, so the footprint is resolution-independent
-    (a frame-wide variant needed ~1 MB of loop-carry stack per class
-    loop and overflowed the 16 MB physical VMEM at 1080p)."""
+    The kernel runs on a 2D grid over (4-MB-row bands x 256-lane
+    chunks): every VMEM buffer is band-sized, so the footprint is
+    resolution-independent (a frame-wide variant overflowed the 16 MB
+    physical VMEM at 1080p), while the 64-row band keeps the MXU's M
+    dimension busy (a 16-row variant was dominated by small-matmul
+    latency — measured ~3x slower)."""
     mbh, mbw = H // 16, W // 16
+    H4 = _round_up(H, 64)               # band-padded height
+    RG = H4 // 64                       # grid rows (bands)
     WcK = _round_up(W, 256)             # chunked luma width (16 MBs/chunk)
     nch = WcK // 256                    # grid chunks
     W2K = WcK + 256                     # wide luma ref lane width
     WcuK = WcK // 2                     # chroma pred width
     W2cK = WcuK + 128                   # wide chroma ref lane width
-    return mbh, mbw, WcK, nch, W2K, WcuK, W2cK
+    return mbh, mbw, H4, RG, WcK, nch, W2K, WcuK, W2cK
 
 
-#: kernel-local (per-chunk) lane widths: two ref lane-blocks each
+#: kernel-local (per-band) lane widths: two ref lane-blocks each
 _LWY = 512                  # luma: 2 x 256-lane blocks
 _LWC = 256                  # chroma: 2 x 128-lane blocks
 
 
 @functools.lru_cache(maxsize=None)
-def _selector_np():
-    """(256, 128) block-sum selector: lane l -> MB l // 16."""
-    s = np.zeros((256, 128), np.float32)
-    for lane in range(256):
-        s[lane, lane // 16] = 1.0
-    return s
+def _ss_np():
+    """(256, 384) per-lane block-sum, luma and chroma fused into ONE
+    matmul: columns [0, 256) put every luma lane's MB SAD on that lane
+    (out[l, l2] = 1 iff l // 16 == l2 // 16), columns [256, 384) do the
+    same for chroma lanes (l // 16 == c // 8). dot(ad, SS) followed by
+    a row-group sum leaves every lane holding its MB's SAD — the
+    running best state stays per-lane and needs no MB->lane
+    expansion."""
+    m = np.zeros((256, 384), np.float32)
+    for l in range(256):
+        mb = l // 16
+        for l2 in range(16 * mb, 16 * mb + 16):
+            m[l, l2] = 1.0
+        for c in range(8 * mb, 8 * mb + 8):
+            m[l, 256 + c] = 1.0
+    return m
 
 
-@functools.lru_cache(maxsize=None)
-def _expander_np(group: int):
-    """(128, 16 * group) MB -> lane expansion: out[m, l] = 1 iff
-    l // group == m (only the chunk's 16 MBs have lanes)."""
-    e = np.zeros((128, 16 * group), np.float32)
-    for lane in range(16 * group):
-        e[lane // group, lane] = 1.0
-    return e
+def _pad_luma_wide(p, H, H4, W, W2K):
+    """(H, W) -> (H4 + 160, W2K + 128) edge-replicated int16 with 16
+    rows/lanes of low-side margin so a per-center dynamic slice at
+    (16 + cy, 16 + cx) re-anchors the plane (centers are clamped to
+    ±_CLIM = ±12; slice row 0 is orig row cy - 32). Centering happens
+    in XLA — the kernel contains no dynamic shifts (Mosaic's
+    dynamic_rotate produced corrupted lanes in composed programs on
+    v5e)."""
+    out = jnp.pad(p, ((48, H4 + 112 - H), (_PH + 16, W2K + 88 - W)),
+                  mode="edge")
+    return out.astype(jnp.int16)
 
 
-def _pad_luma_wide(p, H, W, W2K):
-    """(H, W) -> (H + 2*_PV + 32, W2K + 128) edge-replicated int16,
-    with 16 extra rows/lanes of low-side margin so a per-center dynamic
-    slice at (16 + cy, 16 + cx) re-anchors the plane (centers are
-    clamped to ±_CLIM = ±12). Centering happens in XLA — the kernel
-    contains no dynamic rotates (Mosaic's dynamic_rotate produced
-    corrupted lanes in composed programs on v5e)."""
-    out = jnp.pad(p, ((_PV + 16, _PV + 16),
-                      (_PH + 16, W2K + 88 - W)), mode="edge")
-    # int32 operands: the layout-canonicalization fusion XLA inserts
-    # for (2,1)-packed int16 custom-call operands corrupts the trailing
-    # sub-tile of each 128-lane tile when the producer is in-program
-    # (observed on v5e); int32 operands take an unpacked path.
-    return out.astype(jnp.int32)
-
-
-def _pad_chroma_wide(p, H, W, W2cK):
+def _pad_chroma_wide(p, H, H4, W, W2cK):
     h2, w2 = H // 2, W // 2
-    out = jnp.pad(p, ((_PVC + 8, _PVC + 16),
-                      (_PHC + 8, W2cK + 104 - w2)), mode="edge")
-    return out.astype(jnp.int32)
+    out = jnp.pad(p, ((24, H4 // 2 + 72 - h2), (_PHC + 8, W2cK + 104 - w2)),
+                  mode="edge")
+    return out.astype(jnp.int16)
 
 
 def _center_stack(wide, starts_r, starts_c, rows, cols):
@@ -250,10 +256,11 @@ def _center_stack(wide, starts_r, starts_c, rows, cols):
         for i in range(3)])
 
 
-def _pad_cur(y, H, W, WcK):
-    if WcK == W:
-        return y.astype(jnp.int32)
-    return jnp.pad(y, ((0, 0), (0, WcK - W)), mode="edge").astype(jnp.int32)
+def _pad_cur(y, H, H4, W, WcK):
+    if WcK == W and H4 == H:
+        return y.astype(jnp.int16)
+    return jnp.pad(y, ((0, H4 - H), (0, WcK - W)),
+                   mode="edge").astype(jnp.int16)
 
 
 # ---------------------------------------------------------------------------
@@ -261,36 +268,33 @@ def _pad_cur(y, H, W, WcK):
 # ---------------------------------------------------------------------------
 
 def _me_kernel(H: int, W: int):
-    mbh, mbw, WcK, nch, W2K, WcuK, W2cK = _geom(H, W)
+    mbh, mbw, H4, RG, WcK, nch, W2K, WcuK, W2cK = _geom(H, W)
 
     def kernel(cent_ref,
                cur_ref,
-               ry00, ry10, ry20, ry01, ry11, ry21,
-               ru00, ru10, ru20, ru01, ru11, ru21,
-               rv00, rv10, rv20, rv01, rv11, rv21,
-               s_ref, sty_ref, stc_ref, _dmv, _dpy, _dpu, _dpv,
+               ry00, ry10, ry20, ry30, ry01, ry11, ry21, ry31,
+               ru00, ru10, ru20, ru30, ru01, ru11, ru21, ru31,
+               rv00, rv10, rv20, rv30, rv01, rv11, rv21, rv31,
+               ss_ref, _dmv, _dpy, _dpu, _dpv,
                mv_ref, py_ref, pu_ref, pv_ref):
         # Inputs arrive PRE-CENTERED per search center (leading dim 3,
-        # XLA-side dynamic slice of a wide pad): no dynamic rotates in
-        # the kernel; all remaining rolls have CONSTANT shifts. The 48
-        # rows x 512 lanes are exactly the 6-tap reach of this chunk's
-        # windows.
+        # XLA-side dynamic slice of a wide pad): no dynamic shifts in
+        # the kernel; all remaining rolls have CONSTANT shifts. The 128
+        # rows x 512 lanes cover this band's windows + 6-tap reach.
         R3 = jnp.concatenate([
-            jnp.concatenate([ry00[:], ry10[:], ry20[:]], axis=1),
-            jnp.concatenate([ry01[:], ry11[:], ry21[:]], axis=1),
-        ], axis=2)                                        # (3, 48, 512)
+            jnp.concatenate([ry00[:], ry10[:], ry20[:], ry30[:]], axis=1),
+            jnp.concatenate([ry01[:], ry11[:], ry21[:], ry31[:]], axis=1),
+        ], axis=2)                                        # (3, 128, 512)
         CU3 = jnp.concatenate([
-            jnp.concatenate([ru00[:], ru10[:], ru20[:]], axis=1),
-            jnp.concatenate([ru01[:], ru11[:], ru21[:]], axis=1),
-        ], axis=2)                                        # (3, 24, 256)
+            jnp.concatenate([ru00[:], ru10[:], ru20[:], ru30[:]], axis=1),
+            jnp.concatenate([ru01[:], ru11[:], ru21[:], ru31[:]], axis=1),
+        ], axis=2)                                        # (3, 64, 256)
         CV3 = jnp.concatenate([
-            jnp.concatenate([rv00[:], rv10[:], rv20[:]], axis=1),
-            jnp.concatenate([rv01[:], rv11[:], rv21[:]], axis=1),
+            jnp.concatenate([rv00[:], rv10[:], rv20[:], rv30[:]], axis=1),
+            jnp.concatenate([rv01[:], rv11[:], rv21[:], rv31[:]], axis=1),
         ], axis=2)
-        cur = cur_ref[:].astype(jnp.bfloat16)             # (16, 256)
-        S = s_ref[:]                                      # (256, 128) bf16
-        STy = sty_ref[:]                                  # (128, 256) bf16
-        STc = stc_ref[:]                                  # (128, 128) bf16
+        cur = cur_ref[:].astype(jnp.bfloat16)             # (64, 256)
+        SS = ss_ref[:]                                    # (256, 384) bf16
         lam = cent_ref[0, 6].astype(jnp.float32)
 
         # constant-shift rolls only; negative shifts wrap mod the size
@@ -304,74 +308,74 @@ def _me_kernel(H: int, W: int):
         def roll01_lanes(x, flag):
             return jnp.where(flag > 0, roll_lanes(x, -1), x)
 
-        bestc = jnp.full((1, 128), 2.0**30, jnp.float32)
-        bmy = jnp.zeros((1, 128), jnp.int32)
-        bmx = jnp.zeros((1, 128), jnp.int32)
-        py = jnp.zeros((16, 256), jnp.bfloat16)
-        pu = jnp.zeros((8, 128), jnp.int16)
-        pv = jnp.zeros((8, 128), jnp.int16)
-        state = (bestc, bmy, bmx, py, pu, pv)
+        # Running best per LANE (4 MB rows x 256 luma / 128 chroma
+        # lanes). Luma and chroma track the same per-MB cost values in
+        # the same order, so their winners agree exactly (integer-exact
+        # f32 sums), and the chroma prediction always matches the coded
+        # luma MV.
+        bestc = jnp.full((4, 256), 2.0**30, jnp.float32)
+        bmy = jnp.zeros((4, 256), jnp.int32)
+        bmx = jnp.zeros((4, 256), jnp.int32)
+        py = jnp.zeros((64, 256), jnp.bfloat16)
+        bestcc = jnp.full((4, 128), 2.0**30, jnp.float32)
+        pu = jnp.zeros((32, 128), jnp.int16)
+        pv = jnp.zeros((32, 128), jnp.int16)
+        state = (bestc, bmy, bmx, py, bestcc, pu, pv)
 
-        # lane bases inside the 512/256-wide local planes: orig sample
-        # q of this chunk sits at luma lane _PH + q, chroma _PHC/2 + q
-        _LBY = _PH                       # 24
-        _LBC = _PHC                      # 16
-
-        def offset_body(state, Lr, Cu9, Cv9, wy, wx, cy, cx):
-            """One candidate: Lr is 16 rows of the class plane, rolled
-            so the candidate occupies lanes [_LBY, _LBY+256); Cu9/Cv9
-            are 9 chroma rows rolled likewise. wy/wx traced."""
-            bestc, bmy, bmx, py, pu, pv = state
-            cand = jax.lax.slice(Lr, (0, _LBY), (16, _LBY + 256)
+        def offset_body(state, Lr, Cu33, Cv33, wy, wx, cy, cx):
+            """One candidate: Lr is 64 rows of the class plane, rolled
+            so the candidate occupies lanes [_PH, _PH+256); Cu33/Cv33
+            are 33 chroma rows rolled likewise. wy/wx traced."""
+            bestc, bmy, bmx, py, bestcc, pu, pv = state
+            cand = jax.lax.slice(Lr, (0, _PH), (64, _PH + 256)
                                  ).astype(jnp.bfloat16)
             ad = jnp.abs(cur - cand)
-            sad2 = jnp.dot(ad, S, preferred_element_type=jnp.float32)
-            sadv = jnp.sum(sad2, axis=0, keepdims=True)   # (1, 128)
+            sad = jnp.dot(ad, SS, preferred_element_type=jnp.float32)
+            sad4a = sad.reshape(4, 16, 384).sum(1)        # (4, 384)
+            sad4 = jax.lax.slice(sad4a, (0, 0), (4, 256))
+            sad4c = jax.lax.slice(sad4a, (0, 256), (4, 384))
             mvy = 2 * cy + wy
             mvx = 2 * cx + wx
-            cost = sadv + lam * (
-                jnp.abs(mvy) + jnp.abs(mvx)).astype(jnp.float32)
-            take = cost < bestc                           # (1, 128) bool
+            pen = lam * (jnp.abs(mvy) + jnp.abs(mvx)).astype(jnp.float32)
+            cost = sad4 + pen
+            take = cost < bestc                           # (4, 256) bool
             bestc = jnp.where(take, cost, bestc)
             bmy = jnp.where(take, mvy, bmy)
             bmx = jnp.where(take, mvx, bmx)
-            # Per-MB -> per-lane mask expansion as an exact matmul with
-            # the selector transpose (0/1 in bf16). pltpu.repeat is a
-            # TILE repeat ([abc] -> [abcabc]), not the element repeat
-            # ([abc] -> [aabbcc]) this needs — using it here corrupted
-            # every macroblock whose neighbors chose different
-            # candidates.
-            tif = take.astype(jnp.bfloat16)
-            tly = jnp.dot(tif, STy, preferred_element_type=jnp.float32)
-            py = jnp.where(jnp.broadcast_to(tly > 0.5, (16, 256)), cand,
-                           py)
+            tly = jnp.broadcast_to(take[:, None, :], (4, 16, 256)
+                                   ).reshape(64, 256)
+            py = jnp.where(tly, cand, py)
+
+            costc = sad4c + pen
+            takec = costc < bestcc                        # (4, 128)
+            bestcc = jnp.where(takec, costc, bestcc)
+            mc = jnp.broadcast_to(takec[:, None, :], (4, 8, 128)
+                                  ).reshape(32, 128)
 
             # §8.4.2.2.2 bilinear, eighth-pel fracs (w & 3) * 2 (traced;
             # exact for frac 0: (64 * a + 32) >> 6 == a).
             ey = (wy & 3) * 2
             ex = (wx & 3) * 2
 
-            def cpred(C9):
-                a = jax.lax.slice(C9, (0, _LBC), (8, _LBC + 128))
-                b = jax.lax.slice(C9, (0, _LBC + 1), (8, _LBC + 129))
-                c = jax.lax.slice(C9, (1, _LBC), (9, _LBC + 128))
-                d = jax.lax.slice(C9, (1, _LBC + 1), (9, _LBC + 129))
+            def cpred(C33):
+                a = jax.lax.slice(C33, (0, _PHC), (32, _PHC + 128))
+                b = jax.lax.slice(C33, (0, _PHC + 1), (32, _PHC + 129))
+                c = jax.lax.slice(C33, (1, _PHC), (33, _PHC + 128))
+                d = jax.lax.slice(C33, (1, _PHC + 1), (33, _PHC + 129))
                 out = ((8 - ex) * (8 - ey) * a + ex * (8 - ey) * b
                        + (8 - ex) * ey * c + ex * ey * d + 32) >> 6
                 return out.astype(jnp.int16)
 
-            tlc = jnp.dot(tif, STc, preferred_element_type=jnp.float32)
-            mc = jnp.broadcast_to(tlc > 0.5, (8, 128))
-            pu = jnp.where(mc, cpred(Cu9), pu)
-            pv = jnp.where(mc, cpred(Cv9), pv)
-            return (bestc, bmy, bmx, py, pu, pv)
+            pu = jnp.where(mc, cpred(Cu33), pu)
+            pv = jnp.where(mc, cpred(Cv33), pv)
+            return (bestc, bmy, bmx, py, bestcc, pu, pv)
 
         def class_scan(plane, CUc, CVc, cy, cx, wys, wxs, state):
             """Walk one parity class's (wys x wxs) grid. The plane and
             chroma planes are pre-rolled to the first offset; each
             fori_loop step rolls by the grid's one-sample stride, so
             every candidate is a static slice and the loop carries are
-            chunk-sized."""
+            band-sized."""
             ny, nx = len(wys), len(wxs)
             wy0, wx0 = wys[0], wxs[0]
             Pl = roll_rows(plane, -(wy0 >> 1))
@@ -381,26 +385,28 @@ def _me_kernel(H: int, W: int):
             def outer(iy, carry):
                 Pl, Cur, Cvr, state = carry
                 wy = wy0 + 2 * iy
-                Lr = jax.lax.slice(Pl, (_KPV, 0), (_KPV + 16, _LWY))
+                # only lanes [0, _PH + 256 + steps) are ever sliced —
+                # a 384-lane slab rolls 25% cheaper than the full 512
+                Lr = jax.lax.slice(Pl, (_KPV, 0), (_KPV + 64, 384))
                 Lr = roll_lanes(Lr, -(wx0 >> 1))
-                Cu9 = roll_lanes(
-                    jax.lax.slice(Cur, (_KPVC, 0), (_KPVC + 9, _LWC)),
+                Cu33 = roll_lanes(
+                    jax.lax.slice(Cur, (_KPVC, 0), (_KPVC + 33, _LWC)),
                     -(wx0 >> 2))
-                Cv9 = roll_lanes(
-                    jax.lax.slice(Cvr, (_KPVC, 0), (_KPVC + 9, _LWC)),
+                Cv33 = roll_lanes(
+                    jax.lax.slice(Cvr, (_KPVC, 0), (_KPVC + 33, _LWC)),
                     -(wx0 >> 2))
 
                 def inner(ix, icarry):
-                    Lr, Cu9, Cv9, state = icarry
+                    Lr, Cu33, Cv33, state = icarry
                     wx = wx0 + 2 * ix
-                    state = offset_body(state, Lr, Cu9, Cv9, wy, wx,
+                    state = offset_body(state, Lr, Cu33, Cv33, wy, wx,
                                         cy, cx)
                     cd = ((wx + 2) >> 2) - (wx >> 2)
-                    return (roll_lanes(Lr, -1), roll01_lanes(Cu9, cd),
-                            roll01_lanes(Cv9, cd), state)
+                    return (roll_lanes(Lr, -1), roll01_lanes(Cu33, cd),
+                            roll01_lanes(Cv33, cd), state)
 
                 _, _, _, state = jax.lax.fori_loop(
-                    0, nx, inner, (Lr, Cu9, Cv9, state))
+                    0, nx, inner, (Lr, Cu33, Cv33, state))
                 rd = ((wy + 2) >> 2) - (wy >> 2)
                 return (roll_rows(Pl, -1), roll01_rows(Cur, rd),
                         roll01_rows(Cvr, rd), state)
@@ -412,34 +418,45 @@ def _me_kernel(H: int, W: int):
         def run_center(ci, classes, state):
             cy = cent_ref[0, 2 * ci]
             cx = cent_ref[0, 2 * ci + 1]
-            # Interpolation planes built DIRECTLY at the 32 rows the
-            # windows slice (row base _KPV); vertical 6-taps as static
-            # row slices — no full-height temporaries.
-            RcT = R3[ci].astype(jnp.int32)                # (48, 512)
+            # Interpolation planes built DIRECTLY over the 80 rows the
+            # windows slice (row base _KPV = band row -8); vertical
+            # 6-taps as static row slices — no full-height temporaries.
+            # R3[ci] local row 0 is band row -32.
+            RcT = R3[ci].astype(jnp.int32)                # (128, 512)
 
-            def vtap(x, r0):
+            def vtap(x, r0, n):
                 W_ = x.shape[1]
-                return (jax.lax.slice(x, (r0 - 2, 0), (r0 + 30, W_))
-                        - 5 * jax.lax.slice(x, (r0 - 1, 0), (r0 + 31, W_))
-                        + 20 * jax.lax.slice(x, (r0, 0), (r0 + 32, W_))
-                        + 20 * jax.lax.slice(x, (r0 + 1, 0), (r0 + 33, W_))
-                        - 5 * jax.lax.slice(x, (r0 + 2, 0), (r0 + 34, W_))
-                        + jax.lax.slice(x, (r0 + 3, 0), (r0 + 35, W_)))
+                return (jax.lax.slice(x, (r0 - 2, 0), (r0 - 2 + n, W_))
+                        - 5 * jax.lax.slice(x, (r0 - 1, 0),
+                                            (r0 - 1 + n, W_))
+                        + 20 * jax.lax.slice(x, (r0, 0), (r0 + n, W_))
+                        + 20 * jax.lax.slice(x, (r0 + 1, 0),
+                                             (r0 + 1 + n, W_))
+                        - 5 * jax.lax.slice(x, (r0 + 2, 0),
+                                            (r0 + 2 + n, W_))
+                        + jax.lax.slice(x, (r0 + 3, 0), (r0 + 3 + n, W_)))
 
-            hb1 = _tap6_lane(jax.lax.slice(RcT, (5, 0), (43, _LWY)),
-                             roll_lanes)                  # rows [5, 43)
-            p0 = jax.lax.slice(RcT, (8, 0), (40, _LWY)).astype(jnp.float32)
-            b = jnp.clip((jax.lax.slice(hb1, (3, 0), (35, _LWY)) + 16)
+            # hb1 rows cover band rows [-11, 75): local hb1 row i is
+            # band row i - 11
+            hb1 = _tap6_lane(jax.lax.slice(RcT, (21, 0), (107, _LWY)),
+                             roll_lanes)
+            p0 = jax.lax.slice(RcT, (24, 0), (104, _LWY)
+                               ).astype(jnp.float32)
+            b = jnp.clip((jax.lax.slice(hb1, (3, 0), (83, _LWY)) + 16)
                          >> 5, 0, 255).astype(jnp.float32)
-            h = jnp.clip((vtap(RcT, 8) + 16) >> 5, 0, 255
+            h = jnp.clip((vtap(RcT, 24, 80) + 16) >> 5, 0, 255
                          ).astype(jnp.float32)
             # j: vertical 6-tap of the unrounded horizontal
-            # intermediates; hb1 row r holds RcT row r + 5
-            j = jnp.clip((vtap(hb1, 3) + 512) >> 10, 0, 255
+            # intermediates
+            j = jnp.clip((vtap(hb1, 3, 80) + 512) >> 10, 0, 255
                          ).astype(jnp.float32)
             planes = (p0, b, h, j)
-            CUc = CU3[ci].astype(jnp.int32)               # (24, 256)
-            CVc = CV3[ci].astype(jnp.int32)
+            # chroma local row 0 is band chroma row -16; trim to
+            # [-8, 40) so _KPVC = 8 aligns with chroma row 0
+            CUc = jax.lax.slice(CU3, (ci, 8, 0), (ci + 1, 56, _LWC)
+                                )[0].astype(jnp.int32)    # (48, 256)
+            CVc = jax.lax.slice(CV3, (ci, 8, 0), (ci + 1, 56, _LWC)
+                                )[0].astype(jnp.int32)
             for (par, wys, wxs) in classes:
                 plane = planes[par[0] * 2 + par[1]]
                 state = class_scan(plane, CUc, CVc, cy, cx, wys, wxs,
@@ -447,59 +464,56 @@ def _me_kernel(H: int, W: int):
             return state
 
         state = run_center(0, CENTER_CLASSES, state)
-        state = run_center(1, CENTER_CLASSES, state)
+        state = run_center(1, CENTER_B_CLASSES, state)
         state = run_center(2, ZERO_CLASSES, state)
-        bestc, bmy, bmx, py, pu, pv = state
+        bestc, bmy, bmx, py, bestcc, pu, pv = state
 
-        mv_ref[0, 0, 0:1, :] = bmy
-        mv_ref[0, 0, 1:2, :] = bmx
-        mv_ref[0, 0, 2:3, :] = bestc.astype(jnp.int32)
-        mv_ref[0, 0, 3:8, :] = jnp.zeros((5, 128), jnp.int32)
-        py_ref[:] = py.astype(jnp.int32)
-        pu_ref[:] = pu.astype(jnp.int32)
-        pv_ref[:] = pv.astype(jnp.int32)
+        mv_ref[0, 0, 0:4, :] = bmy
+        mv_ref[0, 0, 4:8, :] = bmx
+        py_ref[:] = py.astype(jnp.int16)
+        pu_ref[:] = pu
+        pv_ref[:] = pv
 
     return kernel
 
 
 @functools.partial(jax.jit, static_argnames=("H", "W", "interpret"))
-def _me_pallas(cent, cur, refy, refu, refv, sel, sty, stc, *, H: int,
+def _me_pallas(cent, cur, refy, refu, refv, ss, *, H: int,
                W: int, interpret: bool):
-    mbh, mbw, WcK, nch, W2K, WcuK, W2cK = _geom(H, W)
+    mbh, mbw, H4, RG, WcK, nch, W2K, WcuK, W2cK = _geom(H, W)
     vspec = lambda shape, imap: pl.BlockSpec(shape, imap,
                                              memory_space=pltpu.VMEM)
     in_specs = [
-        pl.BlockSpec((1, 8), lambda i, c: (0, 0), memory_space=pltpu.SMEM),
-        vspec((16, 256), lambda i, c: (i, c)),
+        pl.BlockSpec((1, 8), lambda r, c: (0, 0), memory_space=pltpu.SMEM),
+        vspec((64, 256), lambda r, c: (r, c)),
     ]
-    # luma ref: 3 row-blocks x 2 lane-blocks, overlapping windows via
-    # the multi-input trick (index maps may not overlap within a spec)
+    # luma ref: 4 x 32-row blocks x 2 lane-blocks, overlapping windows
+    # via the multi-input trick (index maps may not overlap in a spec)
     for kl in range(2):
-        for k in range(1, 4):
-            in_specs.append(vspec((3, 16, 256), functools.partial(
-                lambda i, c, k=0, kl=0: (0, i + k, c + kl), k=k, kl=kl)))
+        for k in range(4):
+            in_specs.append(vspec((3, 32, 256), functools.partial(
+                lambda r, c, k=0, kl=0: (0, 2 * r + k, c + kl),
+                k=k, kl=kl)))
     for plane in range(2):
         for kl in range(2):
-            for k in range(1, 4):
-                in_specs.append(vspec((3, 8, 128), functools.partial(
-                    lambda i, c, k=0, kl=0: (0, i + k, c + kl),
+            for k in range(4):
+                in_specs.append(vspec((3, 16, 128), functools.partial(
+                    lambda r, c, k=0, kl=0: (0, 2 * r + k, c + kl),
                     k=k, kl=kl)))
-    in_specs.append(vspec((256, 128), lambda i, c: (0, 0)))
-    in_specs.append(vspec((128, 256), lambda i, c: (0, 0)))
-    in_specs.append(vspec((128, 128), lambda i, c: (0, 0)))
+    in_specs.append(vspec((256, 384), lambda r, c: (0, 0)))
 
     out_shape = (
-        jax.ShapeDtypeStruct((mbh, nch, 8, 128), jnp.int32),
-        jax.ShapeDtypeStruct((H, WcK), jnp.int32),
-        jax.ShapeDtypeStruct((H // 2, WcuK), jnp.int32),
-        jax.ShapeDtypeStruct((H // 2, WcuK), jnp.int32),
+        jax.ShapeDtypeStruct((RG, nch, 8, 256), jnp.int32),
+        jax.ShapeDtypeStruct((H4, WcK), jnp.int16),
+        jax.ShapeDtypeStruct((H4 // 2, WcuK), jnp.int16),
+        jax.ShapeDtypeStruct((H4 // 2, WcuK), jnp.int16),
     )
     out_specs = (
-        pl.BlockSpec((1, 1, 8, 128), lambda i, c: (i, c, 0, 0),
+        pl.BlockSpec((1, 1, 8, 256), lambda r, c: (r, c, 0, 0),
                      memory_space=pltpu.VMEM),
-        vspec((16, 256), lambda i, c: (i, c)),
-        vspec((8, 128), lambda i, c: (i, c)),
-        vspec((8, 128), lambda i, c: (i, c)),
+        vspec((64, 256), lambda r, c: (r, c)),
+        vspec((32, 128), lambda r, c: (r, c)),
+        vspec((32, 128), lambda r, c: (r, c)),
     )
     # Output buffers are pre-allocated as aliased dummy INPUTS: the
     # kernel reads overlapping reference windows across grid steps, so
@@ -507,25 +521,25 @@ def _me_pallas(cent, cur, refy, refu, refv, sel, sty, stc, *, H: int,
     # ref operands — the aliased dummies' live ranges overlap every
     # operand's, forcing disjoint allocations. Data-dependent (not
     # constants) so XLA cannot CSE them.
-    z32 = (cur[0, 0] * 0).astype(jnp.int32)
+    z16 = (cur[0, 0] * 0).astype(jnp.int16)
     dummies = (
-        jnp.zeros((mbh, nch, 8, 128), jnp.int32) + z32,
-        jnp.zeros((H, WcK), jnp.int32) + z32,
-        jnp.zeros((H // 2, WcuK), jnp.int32) + z32,
-        jnp.zeros((H // 2, WcuK), jnp.int32) + z32,
+        jnp.zeros((RG, nch, 8, 256), jnp.int32) + z16.astype(jnp.int32),
+        jnp.zeros((H4, WcK), jnp.int16) + z16,
+        jnp.zeros((H4 // 2, WcuK), jnp.int16) + z16,
+        jnp.zeros((H4 // 2, WcuK), jnp.int16) + z16,
     )
     in_specs += list(out_specs)
-    n_in = 23
+    n_in = 27
     return pl.pallas_call(
         _me_kernel(H, W),
-        grid=(mbh, nch),
+        grid=(RG, nch),
         out_shape=out_shape,
         in_specs=in_specs,
         out_specs=out_specs,
         interpret=interpret,
         input_output_aliases={n_in + i: i for i in range(4)},
     )(cent, cur,
-      *[refy] * 6, *[refu] * 6, *[refv] * 6, sel, sty, stc, *dummies)
+      *[refy] * 8, *[refu] * 8, *[refv] * 8, ss, *dummies)
 
 
 # ---------------------------------------------------------------------------
@@ -695,33 +709,34 @@ def me_search_pallas(cur_y16, ref_y16, ref_u16, ref_v16, centers, lam,
     against `me_search_xla` (tests/test_jaxme.py) exercises exactly the
     production kernel code path."""
     H, W = cur_y16.shape
-    mbh, mbw, WcK, nch, W2K, WcuK, W2cK = _geom(H, W)
+    mbh, mbw, H4, RG, WcK, nch, W2K, WcuK, W2cK = _geom(H, W)
     cent = jnp.concatenate(
         [centers[:2].reshape(-1), jnp.zeros(2, jnp.int32),
          lam.reshape(1), jnp.zeros(1, jnp.int32)]).reshape(1, 8)
-    cur = _pad_cur(cur_y16, H, W, WcK)
-    wy_ = _pad_luma_wide(ref_y16, H, W, W2K)
-    wu_ = _pad_chroma_wide(ref_u16, H, W, W2cK)
-    wv_ = _pad_chroma_wide(ref_v16, H, W, W2cK)
+    cur = _pad_cur(cur_y16, H, H4, W, WcK)
+    wy_ = _pad_luma_wide(ref_y16, H, H4, W, W2K)
+    wu_ = _pad_chroma_wide(ref_u16, H, H4, W, W2cK)
+    wv_ = _pad_chroma_wide(ref_v16, H, H4, W, W2cK)
     cys = [16 + centers[i, 0] for i in range(3)]
     cxs = [16 + centers[i, 1] for i in range(3)]
-    refy = _center_stack(wy_, cys, cxs, H + 2 * _PV, W2K)
+    refy = _center_stack(wy_, cys, cxs, H4 + 128, W2K)
     ccys = [8 + (centers[i, 0] >> 1) for i in range(3)]
     ccxs = [8 + (centers[i, 1] >> 1) for i in range(3)]
-    refu = _center_stack(wu_, ccys, ccxs, H // 2 + 40, W2cK)
-    refv = _center_stack(wv_, ccys, ccxs, H // 2 + 40, W2cK)
-    sel = jnp.asarray(_selector_np(), jnp.bfloat16)
-    sty = jnp.asarray(_expander_np(16), jnp.bfloat16)
-    stc = jnp.asarray(_expander_np(8), jnp.bfloat16)
-    mvo, py, pu, pv = _me_pallas(cent, cur, refy, refu, refv, sel,
-                                 sty, stc, H=H, W=W, interpret=interpret)
-    # (mbh, nch, 8, 128): rows 0/1 = bmy/bmx, 16 MBs per chunk
-    bmy = mvo[:, :, 0, :16].reshape(mbh, nch * 16)[:, :mbw]
-    bmx = mvo[:, :, 1, :16].reshape(mbh, nch * 16)[:, :mbw]
-    mv = jnp.stack([bmy, bmx], axis=-1)
-    return (mv, py[:, :W].astype(jnp.int16),
-            pu[:, :W // 2].astype(jnp.int16),
-            pv[:, :W // 2].astype(jnp.int16))
+    refu = _center_stack(wu_, ccys, ccxs, H4 // 2 + 64, W2cK)
+    refv = _center_stack(wv_, ccys, ccxs, H4 // 2 + 64, W2cK)
+    ss = jnp.asarray(_ss_np(), jnp.bfloat16)
+    mvo, py, pu, pv = _me_pallas(cent, cur, refy, refu, refv, ss,
+                                 H=H, W=W, interpret=interpret)
+    # (RG, nch, 8, 256): rows 0:4 = bmy, 4:8 = bmx, one per MB row of
+    # the band; per-MB values sit at every 16th lane
+    bmy = mvo[:, :, 0:4, ::16]                    # (RG, nch, 4, 16)
+    bmx = mvo[:, :, 4:8, ::16]
+    bmy = bmy.transpose(0, 2, 1, 3).reshape(4 * RG, nch * 16)
+    bmx = bmx.transpose(0, 2, 1, 3).reshape(4 * RG, nch * 16)
+    mv = jnp.stack([bmy[:mbh, :mbw], bmx[:mbh, :mbw]], axis=-1)
+    return (mv, py[:H, :W].astype(jnp.int16),
+            pu[:H // 2, :W // 2].astype(jnp.int16),
+            pv[:H // 2, :W // 2].astype(jnp.int16))
 
 
 def me_search(cur_y16, ref_y16, ref_u16, ref_v16, pred_mv_h, qp):
