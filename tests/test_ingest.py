@@ -82,7 +82,7 @@ class TestWatchIngester:
         led = FileLedger(str(tmp_path / "processed.log"))
         calls = []
 
-        def recording_submit(path):
+        def recording_submit(path, state="missing"):
             calls.append(path)
             return True
 
@@ -136,7 +136,7 @@ class TestWatchIngester:
         assert ing.scan_once() == ["new.y4m"]
 
     def test_failed_submit_not_marked(self, tmp_path):
-        def refuse(path):
+        def refuse(path, state="missing"):
             return False
 
         watch, led, ing, calls = self.make(tmp_path, stable_checks=1,
@@ -218,3 +218,48 @@ class TestCoordinatorGlue:
         assert ing.scan_once() == ["manual.y4m"]   # ledgered...
         assert len(co.store.list()) == 1           # ...but no new job
         assert ing.scan_once() == []
+
+    def test_redropped_changed_file_reregistered(self, tmp_path):
+        """A file re-dropped with CHANGED content must create a NEW job
+        even though a job for the same path already exists (round-4 open
+        finding: the path-only dedup ledgered the change and the new cut
+        was never transcoded)."""
+        from thinvids_tpu.cluster.coordinator import Coordinator
+
+        co = Coordinator()
+        watch = tmp_path / "watch"
+        watch.mkdir()
+        clip = watch / "movie.y4m"
+        make_clip(str(clip), n=3)
+        led = FileLedger(str(tmp_path / "processed.log"))
+        ing = WatchIngester(str(watch), led, coordinator_submitter(co),
+                            stable_checks=1)
+        assert ing.scan_once() == ["movie.y4m"]
+        assert len(co.store.list()) == 1
+
+        # re-drop with a different cut (content + frame count change)
+        make_clip(str(clip), n=6)
+        os.utime(clip, ns=(2 * 10**15, 2 * 10**15))
+        assert ing.scan_once() == ["movie.y4m"]
+        jobs = co.store.list()
+        assert len(jobs) == 2
+        assert {j.meta.num_frames for j in jobs} == {3, 6}
+        # the superseded job was fenced out: it must not later commit a
+        # stale output over the new cut's
+        from thinvids_tpu.core.status import Status
+        old = next(j for j in jobs if j.meta.num_frames == 3)
+        assert old.status is Status.STOPPED
+
+        # a same-length re-edit (identical probe meta, different pixels)
+        # is still 'changed' per the ledger signature and re-registers —
+        # probe meta alone can't distinguish it
+        frames = [Frame(np.full((16, 32), 200 - 10 * i, np.uint8),
+                        np.full((8, 16), 90, np.uint8),
+                        np.full((8, 16), 160, np.uint8))
+                  for i in range(6)]
+        from thinvids_tpu.io.y4m import write_y4m as _wy
+        _wy(str(clip), VideoMeta(width=32, height=16, fps_num=30,
+                                 fps_den=1, num_frames=6), frames)
+        os.utime(clip, ns=(3 * 10**15, 3 * 10**15))
+        assert ing.scan_once() == ["movie.y4m"]
+        assert len(co.store.list()) == 3

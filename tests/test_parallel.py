@@ -180,6 +180,50 @@ class TestShardedInterDispatch:
                 idr_pic_id=gop.index))
         assert got == b"".join(parts)
 
+    def test_low_qp_stays_on_sparse_path(self, monkeypatch):
+        """Saturated chroma drives intra chroma DC past int8 at QP <= 20
+        (measured: |level| up to ~250 at QP 15); with BOTH hadamard DC
+        segments shipping dense, a low-QP encode must keep the sparse
+        transfer — the wave-wide dense fallback raising here proves the
+        trap is closed — and stay bit-identical to the reference."""
+        from thinvids_tpu.codecs.h264.encoder import encode_gop
+        from thinvids_tpu.parallel import dispatch as dispatch_mod
+
+        def boom(*a, **k):
+            raise AssertionError("dense fallback taken at low QP")
+
+        monkeypatch.setattr(dispatch_mod, "_encode_gop_single_dense", boom)
+        monkeypatch.setattr(dispatch_mod, "_encode_wave_gop_dense", boom)
+        # smooth luma (sparse residuals fit the block budget even at low
+        # QP) + saturated chroma (its hadamard DC escapes int8)
+        w, h, n = 64, 48, 8
+        yy, xx = np.mgrid[0:h, 0:w]
+        frames = [Frame(
+            y=np.clip(xx // 4 * 2 + 60 + 2 * i, 0, 255).astype(np.uint8),
+            u=np.full((h // 2, w // 2), 235, np.uint8),
+            v=np.full((h // 2, w // 2), 20, np.uint8),
+        ) for i in range(n)]
+        meta = VideoMeta(width=w, height=h, num_frames=n)
+
+        # the trap must actually be armed: intra chroma DC escapes int8
+        from thinvids_tpu.codecs.h264 import jaxinter
+        import jax.numpy as jnp
+
+        nmb = (w // 16) * (h // 16)
+        _mv, flat = jaxinter.encode_gop_planes(
+            jnp.asarray(np.stack([f.y for f in frames[:2]])),
+            jnp.asarray(np.stack([f.u for f in frames[:2]])),
+            jnp.asarray(np.stack([f.v for f in frames[:2]])),
+            jnp.asarray(15), mbw=w // 16, mbh=h // 16)
+        cdc = np.asarray(flat)[nmb * 256:nmb * 264]
+        assert np.abs(cdc).max() > 127
+        got = encode_clip_sharded(frames, meta, qp=15, gop_frames=2)
+        plan = plan_segments(n, 2, len(jax.devices()))
+        parts = [encode_gop(frames[g.start_frame:g.end_frame], meta,
+                            qp=15, idr_pic_id=g.index)
+                 for g in plan.gops]
+        assert got == b"".join(parts)
+
     def test_block_sparse2_roundtrip(self):
         # two-tier device pack <-> host unpack over clustered content
         # and a non-multiple-of-16 length

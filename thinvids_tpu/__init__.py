@@ -25,7 +25,8 @@ Layout:
     parallel/  segment planner, mesh helpers, shard_map GOP dispatch,
                psum rate control
     cluster/   coordinator, durable job store, admission policy, executor,
-               node agent (host + HBM metrics)
+               node agent (host + HBM metrics), remote worker backend
+               (HTTP shard board + worker daemon, cluster/remote.py)
     ingest/    watch-folder discovery + processed ledger, native probe,
                input decode (.y4m, .mp4/AVC via bound libavcodec)
     io/        y4m reader/writer, bit writer, MP4 muxer/demuxer with
@@ -35,7 +36,8 @@ Layout:
     tools/     libavcodec ctypes oracle, PSNR/SSIM metrics, stamp/seam
                watermark harness
     native/    C++ hot paths (CAVLC entropy packing) loaded via ctypes
-    cli.py     coordinator + agent daemon entrypoints (deploy/*.service)
+    cli.py     coordinator + agent + worker daemon entrypoints
+               (deploy/*.service)
 
 Known deviation: H.264 in-loop deblocking stays disabled in the emitted
 bitstreams (PPS/slice flags). The spec's filter order is an MB-raster

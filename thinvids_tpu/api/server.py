@@ -82,9 +82,14 @@ class ApiServer:
 
     def __init__(self, coordinator: Coordinator, host: str = "127.0.0.1",
                  port: int = 0,
-                 browse_roots: dict[str, str] | None = None) -> None:
+                 browse_roots: dict[str, str] | None = None,
+                 work=None) -> None:
         self.coordinator = coordinator
         self.browse_roots = dict(browse_roots or {})
+        #: optional ShardBoard (cluster/remote.py): when attached, the
+        #: /work/* routes serve the worker-daemon pull API and
+        #: /metrics_snapshot carries the farm's shard stats
+        self.work = work
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -106,6 +111,11 @@ class ApiServer:
                 if not length:
                     return {}
                 raw = self.rfile.read(length)
+                ctype = self.headers.get("Content-Type") or ""
+                if "application/octet-stream" in ctype:
+                    # binary upload (worker part streams): hand the raw
+                    # bytes through under a reserved key
+                    return {"_raw": raw}
                 try:
                     data = json.loads(raw)
                 except json.JSONDecodeError as exc:
@@ -228,6 +238,10 @@ class ApiServer:
         ("POST", r"^/nodes/enable/(?P<host>[\w.-]+)$", "node_enable"),
         ("DELETE", r"^/nodes/delete/(?P<host>[\w.-]+)$", "node_delete"),
         ("GET", r"^/metrics_snapshot$", "metrics_snapshot"),
+        ("POST", r"^/work/claim$", "work_claim"),
+        ("POST", r"^/work/part/(?P<shard_id>[\w:-]+)$", "work_part"),
+        ("POST", r"^/work/status$", "work_status"),
+        ("GET", r"^/work/board$", "work_board"),
         ("GET", r"^/settings$", "get_settings"),
         ("POST", r"^/settings$", "post_settings"),
         ("GET", r"^/browse/list$", "browse_list"),
@@ -498,7 +512,54 @@ class ApiServer:
     def _h_metrics_snapshot(self, query, body) -> tuple[int, Any]:
         metrics = {w.host: dict(w.metrics, last_seen=w.last_seen)
                    for w in self.coordinator.registry.all()}
-        return 200, {"metrics": metrics}
+        out: dict[str, Any] = {"metrics": metrics}
+        if self.work is not None:
+            out["work"] = self.work.snapshot()
+        return 200, out
+
+    # -- worker pull API (cluster/remote.py ShardBoard) ----------------
+
+    def _work_board_or_503(self):
+        if self.work is None:
+            raise ApiError(503, "no remote work backend "
+                                "(execution_backend != remote)")
+        return self.work
+
+    def _h_work_claim(self, query, body) -> tuple[int, Any]:
+        board = self._work_board_or_503()
+        host = str(body.get("host", "")).strip()
+        if not host:
+            raise ApiError(400, "host required")
+        return 200, {"shard": board.claim(host)}
+
+    def _h_work_part(self, query, body, shard_id) -> tuple[int, Any]:
+        from ..cluster.remote import unpack_parts
+
+        board = self._work_board_or_503()
+        host = query.get("host", "").strip()
+        if not host:
+            # same contract as /work/claim: an empty host would record
+            # shard results against a phantom "" registry row
+            raise ApiError(400, "host query parameter required")
+        raw = body.get("_raw")
+        if not isinstance(raw, (bytes, bytearray)):
+            raise ApiError(400, "binary part body required "
+                                "(Content-Type: application/octet-stream)")
+        segments = unpack_parts(bytes(raw))
+        ok = board.submit_part(shard_id, host, segments)
+        return 200, {"ok": ok}
+
+    def _h_work_status(self, query, body) -> tuple[int, Any]:
+        board = self._work_board_or_503()
+        shard_id = str(body.get("shard_id", "")).strip()
+        if not shard_id:
+            raise ApiError(400, "shard_id required")
+        board.report_failure(shard_id, str(body.get("host", "")),
+                             str(body.get("error", "worker error")))
+        return 200, {"ok": True}
+
+    def _h_work_board(self, query, body) -> tuple[int, Any]:
+        return 200, self._work_board_or_503().snapshot()
 
     def _h_get_settings(self, query, body) -> tuple[int, Any]:
         snap = self.coordinator._settings_fn()

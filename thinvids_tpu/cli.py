@@ -1,14 +1,22 @@
-"""Process entrypoints: coordinator and agent daemons.
+"""Process entrypoints: coordinator, agent, and worker daemons.
 
 `python -m thinvids_tpu.cli coordinator` is the manager-host process —
 the union of the reference's gunicorn app + watcher daemon +
 housekeeping unit (/root/reference/ansible_manager.yml:264-349):
 durable coordinator, executor, HTTP API + dashboard, watch-folder
-ingest, orphan recovery, scheduler kicks.
+ingest, orphan recovery, scheduler kicks. With
+``TVT_EXECUTION_BACKEND=remote`` (or the live setting) the encode
+stage dispatches GOP shards to worker daemons instead of the local
+device mesh (cluster/remote.py).
 
-`python -m thinvids_tpu.cli agent` is the worker-host daemon — the
-reference's thinman-agent (/root/reference/agent/agent.py): 1 Hz
+`python -m thinvids_tpu.cli agent` is the metrics-only host daemon —
+the reference's thinman-agent (/root/reference/agent/agent.py): 1 Hz
 host + accelerator metrics heartbeats to the coordinator API.
+
+`python -m thinvids_tpu.cli worker` is an encode-farm node: the agent's
+heartbeats PLUS the claim → encode → stream-back loop against the
+coordinator's /work API (the reference's Huey worker consuming the
+encode queue, /root/reference/worker/tasks.py:1167-1281).
 """
 
 from __future__ import annotations
@@ -28,10 +36,23 @@ def run_coordinator(args: argparse.Namespace) -> None:
     from .ingest import FileLedger, WatchIngester, coordinator_submitter \
         as ingest_submitter
 
+    from .core.config import get_settings
+
     log = get_logging("thinvids_tpu.coordinator")
     state_dir = args.state_dir or os.environ.get("TVT_STATE_DIR")
     co = Coordinator(state_dir=state_dir)
-    execu = LocalExecutor(co, args.output_dir, sync=False)
+    backend = str(getattr(args, "backend", "") or
+                  get_settings().execution_backend)
+    if backend == "remote":
+        from .cluster.remote import RemoteExecutor
+
+        execu = RemoteExecutor(co, args.output_dir, sync=False)
+        work = execu.board
+        log.info("remote execution backend: encode shards dispatch to "
+                 "worker daemons via /work")
+    else:
+        execu = LocalExecutor(co, args.output_dir, sync=False)
+        work = None
     co._launcher = execu.launch
     requeued = co.recover_jobs()
     if requeued:
@@ -45,7 +66,7 @@ def run_coordinator(args: argparse.Namespace) -> None:
              (("watch", args.watch_dir), ("library", args.output_dir))
              if path}
     api = ApiServer(co, host=args.host, port=args.port,
-                    browse_roots=roots).start()
+                    browse_roots=roots, work=work).start()
     log.info("api + dashboard on %s", api.url)
 
     # Local agent: the coordinator host reports its own health, and its
@@ -104,6 +125,37 @@ def run_coordinator(args: argparse.Namespace) -> None:
         shutdown()
 
 
+def run_worker(args: argparse.Namespace) -> None:
+    from .cluster.agent import NodeAgent, http_submitter
+    from .cluster.remote import WorkerDaemon
+    from .core.log import get_logging
+
+    log = get_logging("thinvids_tpu.worker")
+    daemon = WorkerDaemon(args.coordinator, host=args.node_name,
+                          poll_s=args.poll)
+    # liveness + health metrics ride the agent heartbeat; the daemon's
+    # shard counters merge in via the extra_metrics seam
+    agent = NodeAgent(http_submitter(args.coordinator), host=daemon.host,
+                      interval_s=args.interval,
+                      extra_metrics=daemon.metrics)
+    agent.start()
+    log.info("worker %s claiming from %s (poll %.1fs)", daemon.host,
+             args.coordinator, daemon.poll_s)
+
+    stop = threading.Event()
+
+    def shutdown(*_sig) -> None:
+        stop.set()
+
+    signal.signal(signal.SIGTERM, shutdown)
+    try:
+        daemon.run_forever(stop)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        agent.stop()
+
+
 def run_agent(args: argparse.Namespace) -> None:
     from .cluster.agent import NodeAgent, http_submitter
     from .core.log import get_logging
@@ -138,15 +190,31 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--output-dir",
                    default=os.environ.get("TVT_OUTPUT_DIR", "./library"))
     c.add_argument("--scan-interval", type=float, default=60.0)
+    c.add_argument("--backend", choices=("local", "remote"), default=None,
+                   help="encode backend; default from "
+                        "TVT_EXECUTION_BACKEND / live settings")
     c.set_defaults(fn=run_coordinator)
 
-    a = sub.add_parser("agent", help="worker: metrics heartbeats")
+    a = sub.add_parser("agent", help="node: metrics heartbeats only")
     a.add_argument("--coordinator",
                    default=os.environ.get("TVT_COORDINATOR_URL",
                                           "http://127.0.0.1:5005"))
     a.add_argument("--node-name", default=None)
     a.add_argument("--interval", type=float, default=1.0)
     a.set_defaults(fn=run_agent)
+
+    w = sub.add_parser("worker", help="encode-farm node: heartbeats + "
+                                      "claim/encode/stream-back loop")
+    w.add_argument("--coordinator",
+                   default=os.environ.get("TVT_COORDINATOR_URL",
+                                          "http://127.0.0.1:5005"))
+    w.add_argument("--node-name", default=None)
+    w.add_argument("--interval", type=float, default=1.0,
+                   help="heartbeat interval (s)")
+    w.add_argument("--poll", type=float, default=None,
+                   help="claim poll interval when idle (s); default "
+                        "from remote_claim_poll_s")
+    w.set_defaults(fn=run_worker)
     return p
 
 

@@ -23,3 +23,8 @@ __all__ = [
     "WorkerRegistry",
     "evaluate_job_policy",
 ]
+
+# NOTE: the remote worker backend (RemoteExecutor / ShardBoard /
+# WorkerDaemon) lives in .remote and is imported lazily by its users —
+# importing it here would pull the encoder (and jax) into every
+# control-plane import.

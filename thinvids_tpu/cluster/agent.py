@@ -107,12 +107,17 @@ class NodeAgent:
                  host: str | None = None, interval_s: float = 1.0,
                  settings_fn=None, idle_probe: Callable[[], bool] = None,
                  suspend_action: Callable[[], None] | None = None,
+                 extra_metrics: Callable[[], Mapping[str, Any]] | None = None,
                  clock: Callable[[], float] = time.time) -> None:
         from ..core.config import get_settings
 
         self.host = host or socket.gethostname()
         self.submit = submit
         self.interval_s = interval_s
+        #: optional per-process gauge source merged into every
+        #: heartbeat — the worker daemon reports its shard counters
+        #: (busy/done/failed) through this seam (cluster/remote.py)
+        self._extra_metrics = extra_metrics
         self._settings_fn = settings_fn or get_settings
         self._idle_probe = idle_probe or (lambda: False)
         self._suspend_action = suspend_action
@@ -131,7 +136,10 @@ class NodeAgent:
         to a minimal heartbeat — a failed psutil call must never kill
         the liveness signal."""
         metrics: dict[str, Any] = {"role": self.role, "ts": self._clock()}
-        for sampler in (sample_host_metrics, sample_device_metrics):
+        samplers = [sample_host_metrics, sample_device_metrics]
+        if self._extra_metrics is not None:
+            samplers.append(self._extra_metrics)
+        for sampler in samplers:
             try:
                 metrics.update(sampler())
             except Exception:            # noqa: BLE001 - degrade, don't die

@@ -57,6 +57,11 @@ class WorkerInfo:
     disabled: bool = False
     quarantine_reason: str = ""
     metrics: dict[str, Any] = dataclasses.field(default_factory=dict)
+    # remote-shard accounting (cluster/remote.py): lifetime counters
+    # plus the consecutive-failure streak the quarantine gate reads
+    shards_done: int = 0
+    shards_failed: int = 0
+    consecutive_failures: int = 0
 
 
 class WorkerRegistry:
@@ -103,6 +108,21 @@ class WorkerRegistry:
     def all(self) -> list[WorkerInfo]:
         with self._lock:
             return [dataclasses.replace(w) for w in self._workers.values()]
+
+    def record_shard_result(self, host: str, ok: bool) -> int:
+        """Update a worker's remote-shard counters; returns the
+        consecutive-failure streak (the quarantine gate's input). A
+        success resets the streak — only an unbroken run of failures
+        marks a worker bad (transient hiccups heal themselves)."""
+        with self._lock:
+            info = self._workers.setdefault(host, WorkerInfo(host=host))
+            if ok:
+                info.shards_done += 1
+                info.consecutive_failures = 0
+            else:
+                info.shards_failed += 1
+                info.consecutive_failures += 1
+            return info.consecutive_failures
 
     def set_disabled(self, host: str, disabled: bool,
                      reason: str = "") -> None:
@@ -294,7 +314,7 @@ class Coordinator:
         if not self.token_is_current(job_id, token):
             return False
         allowed = {"segment_progress", "encode_progress", "combine_progress",
-                   "parts_total", "parts_done"}
+                   "parts_total", "parts_done", "parts_retried"}
         bad = set(fields) - allowed
         if bad:
             raise ValueError(f"unknown progress fields {sorted(bad)}")

@@ -93,38 +93,24 @@ class LocalExecutor:
     def run(self, job: Job) -> None:
         co = self.coordinator
         token = job.run_token
-        stage = "probe"
+        # one-element list: the encode hook advances the stage marker in
+        # place so failure attribution survives the subclass seam
+        stage = ["probe"]
         try:
             settings = co.job_settings(job)
-            co.heartbeat_job(job.id, token, stage, host=self.host)
+            co.heartbeat_job(job.id, token, stage[0], host=self.host)
             meta, frames, audio = read_video(job.input_path)
             if not frames:
                 raise ValueError(f"no frames in {job.input_path}")
             if not co.mark_running(job.id, token):
                 raise HaltedError("fenced before start")
 
-            stage = "segment"
-            enc = self._encoder_factory(meta, settings, self.mesh)
-            plan = enc.plan(len(frames))
-            co.update_progress(job.id, token, parts_total=plan.num_gops,
-                               segment_progress=100.0)
-            co.heartbeat_job(job.id, token,
-                             stage, host=self.host,
-                             note=f"{plan.num_gops} GOPs planned")
-
-            stage = "encode"
-            target_kbps = float(settings.get("target_bitrate_kbps", 0.0))
             with self._maybe_trace(settings, job):
-                if str(settings.rc_mode) == "vbr2pass" and target_kbps > 0:
-                    segments = self._encode_vbr2pass(
-                        job, token, enc, frames, settings, meta,
-                        target_kbps)
-                else:
-                    segments = self._encode_with_retry(job, token, enc,
-                                                       frames, settings)
+                segments = self._encode_job(job, token, frames, settings,
+                                            meta, stage)
 
-            stage = "stitch"
-            co.heartbeat_job(job.id, token, stage, host=self.host)
+            stage[0] = "stitch"
+            co.heartbeat_job(job.id, token, stage[0], host=self.host)
             stream = concat_segments(segments)
             base = os.path.splitext(os.path.basename(job.input_path))[0]
             out_path = os.path.join(self.output_dir, base + ".mp4")
@@ -140,8 +126,31 @@ class LocalExecutor:
         except HaltedError:
             pass                            # fenced: a newer run owns the job
         except Exception as exc:            # noqa: BLE001 - attribute & fail
-            co.fail_job(job.id, token, stage=stage, host=self.host,
+            co.fail_job(job.id, token, stage=stage[0], host=self.host,
                         reason=f"{type(exc).__name__}: {exc}")
+
+    def _encode_job(self, job: Job, token: str, frames, settings, meta,
+                    stage: list) -> list:
+        """segment + encode stages → ordered EncodedSegments. The seam
+        the remote backend overrides (cluster/remote.py dispatches GOP
+        shards to worker daemons here); this implementation runs on the
+        local process's device mesh. `stage` is a one-element list the
+        hook mutates for failure attribution."""
+        co = self.coordinator
+        stage[0] = "segment"
+        enc = self._encoder_factory(meta, settings, self.mesh)
+        plan = enc.plan(len(frames))
+        co.update_progress(job.id, token, parts_total=plan.num_gops,
+                           segment_progress=100.0)
+        co.heartbeat_job(job.id, token, stage[0], host=self.host,
+                         note=f"{plan.num_gops} GOPs planned")
+
+        stage[0] = "encode"
+        target_kbps = float(settings.get("target_bitrate_kbps", 0.0))
+        if str(settings.rc_mode) == "vbr2pass" and target_kbps > 0:
+            return self._encode_vbr2pass(job, token, enc, frames,
+                                         settings, meta, target_kbps)
+        return self._encode_with_retry(job, token, enc, frames, settings)
 
     @staticmethod
     def _maybe_trace(settings, job: Job):
@@ -311,6 +320,8 @@ class LocalExecutor:
                 co.activity.emit(
                     "encode", f"wave {i} attempt {n} failed, retrying: "
                     f"{exc}", job_id=job.id, host=self.host)
+                retried = co.store.get(job.id).parts_retried + len(staged[0])
+                co.update_progress(job.id, token, parts_retried=retried)
                 halt_check()
                 pending.appendleft((i, staged, enc.dispatch_wave(staged)))
                 continue

@@ -53,6 +53,10 @@ class Job:
     combine_progress: float = 0.0
     parts_total: int = 0
     parts_done: int = 0
+    # parts re-dispatched after a worker failure/timeout (remote
+    # backend) or wave retry — the farm-health signal the dashboard
+    # surfaces next to parts_done
+    parts_retried: int = 0
     # heartbeat (throttled writes; watchdog liveness source)
     heartbeat_at: float = 0.0
     heartbeat_stage: str = ""

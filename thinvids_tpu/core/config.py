@@ -9,6 +9,16 @@ The live tier is an in-process dict guarded by a lock with a TTL read cache
 (the reference used a Redis hash with a 10 s cache); the cluster API mutates
 it via ``update_live_settings`` with the same validation/clamping the
 reference applied in its POST /settings handler.
+
+Every key is overridable from the environment as ``TVT_<KEY_UPPERCASED>``
+(e.g. ``TVT_QP=30``, ``TVT_EXECUTION_BACKEND=remote``). The remote worker
+backend (cluster/remote.py) adds the ``execution_backend`` switch and the
+``remote_*`` family below: shard sizing (``remote_shard_gops``,
+``remote_plan_devices``), the per-shard lease/retry policy
+(``remote_shard_timeout_s``, ``remote_retry_backoff_s``, worker quarantine
+at ``remote_worker_max_failures`` consecutive failures), the
+all-workers-dead failure budget (``remote_no_worker_grace_s``), and the
+worker daemon's claim poll (``remote_claim_poll_s``).
 """
 
 from __future__ import annotations
@@ -58,6 +68,16 @@ DEFAULT_SETTINGS: dict[str, Any] = {
     "suspend_enabled": False,
     "suspend_idle_s": 300.0,
     "suspend_cpu_pct": 20.0,
+    # remote worker execution backend (cluster/remote.py)
+    "execution_backend": "local",    # local | remote
+    "remote_shard_gops": 0,          # GOPs per shard; 0 = auto (~2/worker)
+    "remote_plan_devices": 0,        # GOP plan width; 0 = live worker count
+    "remote_shard_timeout_s": 120.0,  # per-GOP lease budget: a shard's
+                                     # lease = this x its GOP count
+    "remote_retry_backoff_s": 2.0,   # requeue backoff base (doubles/attempt)
+    "remote_worker_max_failures": 3,  # consecutive failures -> quarantine
+    "remote_no_worker_grace_s": 30.0,  # no live workers this long -> job fails
+    "remote_claim_poll_s": 1.0,      # worker daemon claim poll interval
 }
 
 _ENV_PREFIX = "TVT_"
@@ -120,6 +140,18 @@ _CLAMPS: dict[str, Callable[[Any], Any]] = {
     "large_file_behavior": lambda v: str(v)
     if str(v) in ("reject", "direct", "nfs")
     else "direct",
+    "execution_backend": lambda v: str(v)
+    if str(v) in ("local", "remote")
+    else "local",
+    "remote_shard_gops": lambda v: min(4096, max(0, as_int(v, 0))),
+    "remote_plan_devices": lambda v: min(4096, max(0, as_int(v, 0))),
+    "remote_shard_timeout_s": lambda v: max(1.0, as_float(v, 120.0)),
+    "remote_retry_backoff_s": lambda v: max(0.0, as_float(v, 2.0)),
+    "remote_worker_max_failures": lambda v: max(1, as_int(v, 3)),
+    "remote_no_worker_grace_s": lambda v: max(0.1, as_float(v, 30.0)),
+    # floor: a non-positive poll would busy-spin idle workers against
+    # the coordinator's /work/claim
+    "remote_claim_poll_s": lambda v: max(0.05, as_float(v, 1.0)),
 }
 
 

@@ -41,3 +41,16 @@ def force_cpu_devices(n: int) -> None:
                 f"{len(jax.devices())} device(s); force_cpu_devices({n}) "
                 f"must be called before the first jax backend use"
             ) from exc
+
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    """Version-portable `shard_map`: top-level `jax.shard_map` where it
+    exists (>= 0.4.38), else the `jax.experimental` spelling this image's
+    jax (0.4.37) still uses. One resolver so every SPMD call site keeps
+    working across the rename."""
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)

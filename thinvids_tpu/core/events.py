@@ -17,7 +17,10 @@ from typing import Any, Iterable
 _STAGE_LABELS = [
     ("error", "ERROR"),
     ("fail", "ERROR"),
+    ("quarantine", "ERROR"),
     ("segment", "SEGMENT"),
+    ("shard", "ENCODE"),
+    ("claim", "ENCODE"),
     ("split", "SEGMENT"),
     ("encode", "ENCODE"),
     ("stitch", "STITCH"),

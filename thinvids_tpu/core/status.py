@@ -45,3 +45,21 @@ class Status(str, enum.Enum):
     @property
     def is_terminal(self) -> bool:
         return self in (Status.STOPPED, Status.FAILED, Status.REJECTED, Status.DONE)
+
+
+class ShardState(str, enum.Enum):
+    """Lifecycle of one remote encode shard (cluster/remote.py): a
+    contiguous GOP range dispatched to a worker daemon. PENDING shards
+    sit on the board; ASSIGNED shards are leased to one worker under a
+    deadline; DONE shards hold their encoded segments until the job
+    stitches; FAILED is terminal (retry budget exhausted)."""
+
+    PENDING = "pending"
+    ASSIGNED = "assigned"
+    DONE = "done"
+    FAILED = "failed"
+
+    @property
+    def is_open(self) -> bool:
+        """True while the shard still needs a worker."""
+        return self in (ShardState.PENDING, ShardState.ASSIGNED)

@@ -144,9 +144,12 @@ class FileLedger:
 class WatchIngester:
     """Scans a watch root and submits stabilized, unprocessed files.
 
-    `submit(abs_path) -> bool` is the injection point — in production
-    :func:`coordinator_submitter`; in tests a recording stub. A True
-    return marks the file processed in the ledger.
+    `submit(abs_path, state) -> bool` is the injection point — in
+    production :func:`coordinator_submitter`; in tests a recording
+    stub. `state` is the ledger verdict for the file ('missing' or
+    'changed'), so the submitter can distinguish a first sighting from
+    a re-drop with new content. A True return marks the file processed
+    in the ledger.
     """
 
     # Watch exactly what the decode stage can ingest — submitting a
@@ -227,7 +230,7 @@ class WatchIngester:
 
             abs_path = os.path.join(self.watch_dir, rel)
             try:
-                accepted = self.submit(abs_path)
+                accepted = self.submit(abs_path, state)
             except Exception:                    # noqa: BLE001 - keep scanning
                 accepted = False
             if accepted:
@@ -258,14 +261,7 @@ def coordinator_submitter(coordinator, activity_host: str = "watcher"):
     retry a corrupt file on every scan forever."""
     from .probe import ProbeError, probe_video
 
-    def submit(abs_path: str) -> bool:
-        # A job already registered for this path (manual /add_job,
-        # stamp copies written into the watch tree) must not re-queue:
-        # returning True ledgers it, the analog of the reference
-        # manager writing the watcher ledger for manual submissions
-        # (_mark_watcher_processed, app.py:828-870).
-        if any(j.input_path == abs_path for j in coordinator.store):
-            return True
+    def submit(abs_path: str, state: str = "missing") -> bool:
         try:
             meta = probe_video(abs_path)
         except ProbeError as exc:
@@ -278,6 +274,34 @@ def coordinator_submitter(coordinator, activity_host: str = "watcher"):
                 "reject", f"unprobeable, skipped: {exc}",
                 host=activity_host)
             return True
+        # A job already registered for this path (manual /add_job, stamp
+        # copies written into the watch tree) must not re-queue:
+        # returning True ledgers it, the analog of the reference manager
+        # writing the watcher ledger for manual submissions
+        # (_mark_watcher_processed, app.py:828-870). BUT a re-drop the
+        # ledger flags as 'changed' is NEW CONTENT and always
+        # re-registers — a path-only dedup swallowed it forever
+        # (round-4 open finding), and even probe meta can't tell a
+        # same-length re-edit apart; only the ledger's size+mtime
+        # signature can. The meta check still guards the 'missing'
+        # path: a job for the same path with different probe meta means
+        # the ledger lost track of a change.
+        if state != "changed" and any(
+                j.input_path == abs_path and j.meta == meta
+                for j in coordinator.store):
+            return True
+        # Re-registering: supersede ANY non-terminal job on this path
+        # (whether the ledger said 'changed' or the meta mismatch on a
+        # 'missing' re-probe revealed it) — a run already encoding this
+        # path holds the OLD content in memory and would commit a stale
+        # output file over the new cut's (both derive
+        # library/<basename>.mp4); stopping it fences its run token.
+        for j in coordinator.store:
+            if j.input_path == abs_path and not j.status.is_terminal:
+                coordinator.stop_job(j.id)
+                coordinator.activity.emit(
+                    "stop", "superseded by re-dropped file with "
+                    "changed content", job_id=j.id, host=activity_host)
         job = coordinator.add_job(abs_path, meta)
         return job is not None
 

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..core.devices import shard_map
 from ..core.types import EncodedSegment, Frame, GopSpec, SegmentPlan, VideoMeta
 from ..codecs.h264.encoder import pack_slice
 from ..codecs.h264.headers import PPS, SPS
@@ -46,20 +47,25 @@ def _flat_levels(y, u, v, qp, mbw, mbh):
 
 
 def _per_gop_sparse(y, u, v, qp, mbw: int, mbh: int):
-    """(F, H, W) GOP → (mv int8, dense intra-DC prefix, two-tier
+    """(F, H, W) GOP → (mv int8, dense intra-DC segments, two-tier
     sparse levels for the rest).
 
-    The intra luma DC segment (nmb * 16 int16, ~260 KB at 1080p)
-    ships DENSE: hadamard DC levels are the only ones that exceed
-    int8 at practical QPs, and the sparse pack has no escape
+    BOTH intra hadamard DC segments — luma DC (nmb * 16) and chroma DC
+    (nmb * 8), ~390 KB combined at 1080p — ship DENSE: hadamard DC
+    levels are the only ones that exceed int8 at practical QPs (chroma
+    DC crosses at QP <~ 20), and the sparse pack has no escape
     side-channel (its full-size scatters were ~60% of the pack's
     device time) — an escape anywhere forces the wave-wide dense
-    fallback."""
+    fallback, so low-QP encodes would otherwise fall permanently into
+    the slow path (ADVICE round 5)."""
     from ..codecs.h264 import jaxinter
 
     mv8, flat = jaxinter.encode_gop_planes(y, u, v, qp, mbw=mbw, mbh=mbh)
-    ndc = mbw * mbh * 16
-    return (mv8, flat[:ndc]) + jaxcore._block_sparse_pack2(flat[ndc:])
+    nmb = mbw * mbh
+    ndc, nlac, ncdc = nmb * 16, nmb * 240, nmb * 8
+    dense = jnp.concatenate([flat[:ndc], flat[ndc + nlac:ndc + nlac + ncdc]])
+    rest = jnp.concatenate([flat[ndc:ndc + nlac], flat[ndc + nlac + ncdc:]])
+    return (mv8, dense) + jaxcore._block_sparse_pack2(rest)
 
 
 def _per_gop_dense(y, u, v, qp, mbw: int, mbh: int, dtype):
@@ -115,7 +121,7 @@ def _encode_wave_gop(ys, us, vs, qps, *, mbw: int, mbh: int, mesh: Mesh):
             return _per_gop_sparse(y, u, v, qp, mbw, mbh)
         return jax.lax.map(one, (y_g, u_g, v_g, qp_g))
 
-    shard = jax.shard_map(
+    shard = shard_map(
         per_dev, mesh=mesh,
         in_specs=(P("gop"),) * 4,
         out_specs=(P("gop"),) * 8,
@@ -154,7 +160,7 @@ def _encode_wave_gop_dense(ys, us, vs, qps, *, mbw: int, mbh: int, mesh: Mesh,
             return _per_gop_dense(y, u, v, qp, mbw, mbh, dtype)
         return jax.lax.map(one, (y_g, u_g, v_g, qp_g))
 
-    shard = jax.shard_map(
+    shard = shard_map(
         per_dev, mesh=mesh,
         in_specs=(P("gop"),) * 4,
         out_specs=P("gop"),
@@ -182,7 +188,7 @@ def _encode_wave(ys, us, vs, qp, *, mbw: int, mbh: int, mesh: Mesh):
 
         return jax.vmap(one)(y_g, u_g, v_g)               # each (1, F, ...)
 
-    shard = jax.shard_map(
+    shard = shard_map(
         per_gop, mesh=mesh,
         in_specs=(P("gop"), P("gop"), P("gop")),
         out_specs=(P("gop"),) * 6,
@@ -206,7 +212,7 @@ def _encode_wave_dense(ys, us, vs, qp, *, mbw: int, mbh: int, mesh: Mesh,
 
         return jax.vmap(one)(y_g, u_g, v_g).astype(dtype)
 
-    shard = jax.shard_map(
+    shard = shard_map(
         per_gop, mesh=mesh,
         in_specs=(P("gop"), P("gop"), P("gop")),
         out_specs=P("gop"),
@@ -246,12 +252,19 @@ class GopShardEncoder:
         #: the segments already completed (cluster/executor.py).
         self.gop_index_offset = 0
         self.frame_offset = 0
+        #: Externally supplied plan (remote shards, cluster/remote.py):
+        #: the EXACT shard-local GOP boundaries to encode, bypassing the
+        #: local planner so a worker reproduces the coordinator's global
+        #: plan bit-for-bit regardless of its own device count.
+        self.plan_override: SegmentPlan | None = None
 
     @property
     def num_devices(self) -> int:
         return self.mesh.devices.size
 
     def plan(self, num_frames: int) -> SegmentPlan:
+        if self.plan_override is not None:
+            return self.plan_override
         return plan_segments(num_frames, self.gop_frames, self.num_devices,
                              self.max_segments)
 
@@ -348,8 +361,10 @@ class GopShardEncoder:
         if self.inter:
             (mv8, dc16, nblk, nval, n_esc, bitmap, bmask16,
              vals) = jax.device_get(out)
-            ndc = nmb * 16
-            Lr = L - ndc
+            # dense prefix = both intra hadamard DC segments (luma +
+            # chroma); the sparse remainder skips them (_per_gop_sparse)
+            ndc, nlac, ncdc = nmb * 16, nmb * 240, nmb * 8
+            Lr = L - ndc - ncdc
             sparse_ok = jaxcore.block_sparse2_fits(
                 nblk.max(), nval.max(), n_esc.max(), Lr)
         else:
@@ -384,11 +399,14 @@ class GopShardEncoder:
             gop_qp = int(qps_host[gi])
             if self.inter:
                 if sparse_ok:
-                    raw = np.concatenate([
-                        np.asarray(dc16[gi]),
-                        jaxcore._block_sparse_unpack2(
-                            int(nblk[gi]), int(nval[gi]), bitmap[gi],
-                            bmask16[gi], vals[gi], Lr)])
+                    dense = np.asarray(dc16[gi])
+                    rest = jaxcore._block_sparse_unpack2(
+                        int(nblk[gi]), int(nval[gi]), bitmap[gi],
+                        bmask16[gi], vals[gi], Lr)
+                    # restore flat layout: luma DC | luma AC | chroma DC
+                    # | chroma AC + P planes
+                    raw = np.concatenate([dense[:ndc], rest[:nlac],
+                                          dense[ndc:], rest[nlac:]])
                 else:
                     raw = flat[gi]
                 payload = self._pack_gop(gop, mv8[gi], raw, F, mbw, mbh,

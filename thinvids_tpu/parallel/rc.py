@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..core.devices import shard_map
 from ..core.types import EncodedSegment, Frame, VideoMeta
 from .dispatch import GopShardEncoder
 
@@ -68,7 +69,7 @@ def _complexity_stats(ys, *, mesh: Mesh | None):
     if mesh is None or mesh.devices.size == 1:
         local = jax.lax.map(per_gop, ys)
         return local, jnp.broadcast_to(jnp.sum(local), local.shape)
-    shard = jax.shard_map(per_dev, mesh=mesh, in_specs=(P("gop"),),
+    shard = shard_map(per_dev, mesh=mesh, in_specs=(P("gop"),),
                           out_specs=(P("gop"), P("gop")))
     return shard(ys)
 
