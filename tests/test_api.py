@@ -129,6 +129,32 @@ class TestBrowsePreviewStamp:
         paths = {j["input_path"] for j in listing["jobs"]}
         assert str(stamped) in paths
 
+    def test_stamp_job_dedups_on_target_path(self, api):
+        # Repeated POST /stamp_job refreshes the stamped file but must
+        # not register a second job for the same .stamped.y4m target.
+        from thinvids_tpu.tools.stamp import stamp_width_px
+
+        server, co, execu, tmp_path = api
+        clip = tmp_path / "movie.y4m"
+        make_clip(str(clip), n=2, w=stamp_width_px(), h=32)
+        code, job = call(f"{server.url}/add_job", "POST",
+                         {"input_path": str(clip), "auto_start": False})
+        jid = job["id"]
+        for _ in range(3):
+            code, _ = call(f"{server.url}/stamp_job/{jid}", "POST", {})
+            assert code == 200
+        stamped = str(tmp_path / "movie.stamped.y4m")
+        dupes = [j for j in co.store.list() if j.input_path == stamped]
+        assert len(dupes) == 1
+
+    def test_metrics_snapshot_carries_stage_ms(self, api):
+        server, *_ = api
+        code, out = call(f"{server.url}/metrics_snapshot")
+        assert code == 200
+        # the live encode-stage breakdown rides the snapshot (empty
+        # aggregate is fine when no encoder has run in this process)
+        assert isinstance(out["stage_ms"], dict)
+
 
 class TestLifecycle:
     def test_full_job_lifecycle_over_http(self, api):

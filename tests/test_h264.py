@@ -282,3 +282,42 @@ class TestGuards:
             pack_slice(levels, 1, 1, sps, pps, 27, native=True)
         with pytest.raises(ValueError, match="too large"):
             pack_slice(levels, 1, 1, sps, pps, 27, native=False)
+
+    def test_native_int16_islice_matches_int32_and_python(self):
+        # The int16 entry (cavlc_pack_islice16, fed by the transfer
+        # layout's zero-copy views) must emit the exact bits of the
+        # int32 entry and of the pure-Python packer.
+        from thinvids_tpu import native
+        from thinvids_tpu.codecs.h264.encoder import FrameLevels, pack_slice
+
+        if not native.available():
+            pytest.skip("no compiler")
+        rng = np.random.default_rng(3)
+        nmb = 12
+        arrs = {
+            "luma_dc": rng.integers(-200, 201, (nmb, 16)),
+            "luma_ac": (rng.integers(-8, 9, (nmb, 16, 15))
+                        * (rng.random((nmb, 16, 15)) < 0.2)),
+            "chroma_dc": rng.integers(-150, 151, (nmb, 2, 4)),
+            "chroma_ac": (rng.integers(-5, 6, (nmb, 2, 4, 15))
+                          * (rng.random((nmb, 2, 4, 15)) < 0.15)),
+        }
+
+        def levels(dtype):
+            return FrameLevels(
+                luma_mode=np.zeros(nmb, np.int32),
+                chroma_mode=np.zeros(nmb, np.int32),
+                **{k: v.astype(dtype) for k, v in arrs.items()})
+
+        sps = SPS(width=64, height=48)
+        pps = PPS(init_qp=27)
+        a32 = pack_slice(levels(np.int32), 4, 3, sps, pps, 27, native=True)
+        a16 = pack_slice(levels(np.int16), 4, 3, sps, pps, 27, native=True)
+        py = pack_slice(levels(np.int32), 4, 3, sps, pps, 27, native=False)
+        assert a16 == a32 == py
+        # escape overflow propagates from the int16 entry too (the
+        # largest int16 level exceeds the 12-bit escape budget)
+        bad = levels(np.int16)
+        bad.luma_ac[0, 0, 0] = 3000
+        with pytest.raises(ValueError, match="too large"):
+            pack_slice(bad, 4, 3, sps, pps, 27, native=True)

@@ -120,3 +120,45 @@ class TestMux:
         monkeypatch.setattr(mp4mod, "_MAX_MDAT", 50)
         with pytest.raises(ValueError, match="32-bit"):
             mp4mod.mux_mp4(stream, meta)
+
+
+class TestProbeSizeZero:
+    """ISO BMFF size==0 ("box extends to end of file") handling in the
+    streaming moov probe (probe_mp4_header)."""
+
+    def _boxes(self, mp4):
+        out, i = {}, 0
+        while i < len(mp4):
+            size = struct.unpack(">I", mp4[i:i + 4])[0]
+            out[mp4[i + 4:i + 8]] = mp4[i:i + size]
+            i += size
+        return out
+
+    def test_probe_size_zero_moov_at_eof(self, tmp_path):
+        from thinvids_tpu.io.mp4 import probe_mp4_header
+
+        meta = VideoMeta(width=64, height=48, fps_num=30, fps_den=1)
+        mp4 = mux_mp4(encode_gop(clip(), meta, qp=30), meta)
+        ref_path = tmp_path / "ref.mp4"
+        ref_path.write_bytes(mp4)
+        boxes = self._boxes(mp4)
+        # moov moved last with size 0 (extends to EOF)
+        moov0 = struct.pack(">I", 0) + boxes[b"moov"][4:]
+        p = tmp_path / "eof_moov.mp4"
+        p.write_bytes(boxes[b"ftyp"] + boxes[b"mdat"] + moov0)
+        assert probe_mp4_header(str(p)) == probe_mp4_header(str(ref_path))
+
+    def test_probe_size_zero_non_moov_stops_at_eof(self, tmp_path):
+        # Regression: a size==0 non-moov box seeked 0 bytes, so the next
+        # iteration re-parsed the box's own PAYLOAD as top-level headers
+        # — here that payload embeds a fake moov the probe used to find.
+        from thinvids_tpu.io.mp4 import probe_mp4_header
+
+        ftyp = struct.pack(">I", 16) + b"ftyp" + b"isom" \
+            + struct.pack(">I", 0)
+        fake_moov = struct.pack(">I", 16) + b"moov" + b"\0" * 8
+        free0 = struct.pack(">I", 0) + b"free" + fake_moov
+        p = tmp_path / "free0.mp4"
+        p.write_bytes(ftyp + free0)
+        with pytest.raises(ValueError, match="no moov"):
+            probe_mp4_header(str(p))

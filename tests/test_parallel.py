@@ -303,3 +303,205 @@ class TestShardedInterDispatch:
         # the win well below the gop ratio (the >=3x bar on realistic
         # content is asserted in test_inter.py).
         assert len(inter_stream) < len(intra_stream) / 1.7
+
+
+class TestHostPipeline:
+    """Stage-profiled wave pipeline: slice-granular threaded pack, the
+    zero-copy int16 unflatten, native sparse unpack, per-GOP QP on the
+    intra path, and the config knobs that size it all."""
+
+    def test_intra_wave_honors_per_gop_qp(self):
+        # Regression (VERDICT Weak #8): the inter=False dispatch passed
+        # one wave-wide scalar QP to the device, so gop_qp overrides
+        # (rate control) silently encoded every GOP at the base QP.
+        from thinvids_tpu.codecs.h264.encoder import (
+            encode_frame_arrays, pack_slice)
+
+        frames = _make_frames(8, seed=21)
+        meta = VideoMeta(width=64, height=48, num_frames=8)
+        enc = GopShardEncoder(meta, qp=27, gop_frames=2, inter=False)
+        plan = enc.plan(len(frames))
+        qp_map = {g.index: 27 + 3 * (g.index % 3) for g in plan.gops}
+        enc.gop_qp = dict(qp_map)
+        got = concat_segments(enc.encode(frames))
+
+        # reference: numpy encode of each frame at ITS GOP's QP, packed
+        # against the same SPS/PPS (init_qp 27 → headers carry the delta)
+        out = []
+        for gop in plan.gops:
+            qp = qp_map[gop.index]
+            for fi, i in enumerate(range(gop.start_frame, gop.end_frame)):
+                padded = frames[i].padded(16)
+                levels, _ = encode_frame_arrays(padded.y, padded.u,
+                                                padded.v, qp)
+                nal = pack_slice(levels, 4, 3, enc.sps, enc.pps, qp,
+                                 idr=True, idr_pic_id=i % 65536)
+                if fi == 0:
+                    nal = enc.sps.to_nal() + enc.pps.to_nal() + nal
+                out.append(nal)
+        assert got == b"".join(out)
+
+    def test_threaded_pack_and_int16_paths_bit_identical(self, monkeypatch):
+        # Parity matrix over the new pack path: slice pool off/on,
+        # native packer vs pure-Python fallback, sparse transfer vs the
+        # forced dense (int16 full-layout -> cavlc_pack_islice16) branch.
+        frames = _make_frames(12, seed=9)
+        meta = VideoMeta(width=64, height=48, num_frames=12)
+
+        def stream(pack_workers):
+            enc = GopShardEncoder(meta, qp=27, gop_frames=3,
+                                  pack_workers=pack_workers)
+            return concat_segments(enc.encode(frames))
+
+        base = stream(1)
+        assert stream(8) == base
+
+        from thinvids_tpu import native as native_mod
+
+        monkeypatch.setattr(native_mod, "available", lambda: False)
+        assert stream(8) == base
+        monkeypatch.undo()
+
+        from thinvids_tpu.codecs.h264 import jaxcore
+
+        monkeypatch.setattr(jaxcore, "block_sparse2_fits",
+                            lambda *a, **k: False)
+        assert stream(8) == base
+        assert stream(1) == base
+
+    def test_intra_threaded_pack_bit_identical(self):
+        frames = _make_frames(8, seed=4)
+        meta = VideoMeta(width=64, height=48, num_frames=8)
+
+        def stream(pack_workers):
+            enc = GopShardEncoder(meta, qp=30, gop_frames=2, inter=False,
+                                  pack_workers=pack_workers)
+            return concat_segments(enc.encode(frames))
+
+        assert stream(8) == stream(1)
+
+    def test_native_sparse_unpack_matches_python(self):
+        from thinvids_tpu import native as native_mod
+        from thinvids_tpu.codecs.h264 import jaxcore
+        import jax.numpy as jnp
+
+        if not native_mod.available():
+            pytest.skip("no compiler")
+        rng = np.random.default_rng(17)
+        L = 16 * 777 + 8                  # non-multiple-of-16 tail
+        flat = np.zeros(L, np.int32)
+        hot = rng.choice(150, 90, replace=False)
+        for b in hot:
+            lanes = rng.choice(16, rng.integers(1, 7), replace=False)
+            flat[b * 16 + lanes] = rng.integers(-120, 121, len(lanes))
+        nblk, nval, n_esc, bitmap, bmask16, vals = [
+            np.asarray(x) for x in
+            jaxcore._block_sparse_pack2(jnp.asarray(flat))]
+        assert jaxcore.block_sparse2_fits(nblk, nval, n_esc, L)
+        want = jaxcore._block_sparse_unpack2(
+            int(nblk), int(nval), bitmap, bmask16, vals, L)
+        got = native_mod.block_sparse_unpack2(
+            int(nblk), int(nval), bitmap, bmask16, vals, L)
+        np.testing.assert_array_equal(got, want)
+        assert got.dtype == np.int16
+        # corrupt counts must raise, not mis-scatter
+        with pytest.raises(ValueError, match="inconsistent"):
+            native_mod.block_sparse_unpack2(
+                int(nblk), int(nval) + 1, bitmap, bmask16, vals, L)
+        # a stray set bit AFTER the nblk-th live block (bitmap/count
+        # disagreement the other way) must raise too, not decode the
+        # block as silent zeros
+        NB = -(-L // 16)
+        bad_bitmap = bitmap.copy()
+        bad_bitmap[(NB - 1) // 8] |= 0x80 >> ((NB - 1) % 8)
+        with pytest.raises(ValueError, match="inconsistent"):
+            native_mod.block_sparse_unpack2(
+                int(nblk), int(nval), bad_bitmap, bmask16, vals, L)
+
+    def test_pack_pool_shuts_down_with_encoder(self):
+        import gc
+
+        meta = VideoMeta(width=64, height=48, num_frames=4)
+        enc = GopShardEncoder(meta, qp=27, pack_workers=2)
+        pool = enc._slice_pool()
+        assert pool is not None and enc._slice_pool() is pool
+        del enc
+        gc.collect()
+        assert pool._shutdown      # finalizer retired the pack threads
+
+    def test_stage_profile_records_every_stage(self):
+        from thinvids_tpu.parallel import dispatch as dispatch_mod
+
+        frames = _make_frames(8, seed=2)
+        meta = VideoMeta(width=64, height=48, num_frames=8)
+        enc = GopShardEncoder(meta, qp=27, gop_frames=2)
+        concat_segments(enc.encode(frames))
+        snap = enc.stages.snapshot()
+        for key in dispatch_mod.STAGE_NAMES:
+            assert key in snap
+        assert snap["waves"] >= 1
+        assert snap["pack"] > 0
+        assert snap["dispatch"] > 0
+        # the process-wide aggregate (the /metrics_snapshot exporter)
+        # includes this live encoder
+        agg = dispatch_mod.stage_snapshot()
+        assert set(dispatch_mod.STAGE_NAMES) <= set(agg)
+        assert agg["pack"] >= snap["pack"]
+        enc.stages.reset()
+        assert enc.stages.snapshot()["pack"] == 0.0
+
+    def test_pack_knobs_read_from_config_env(self, monkeypatch):
+        from thinvids_tpu.core.config import invalidate_settings_cache
+
+        monkeypatch.setenv("TVT_PACK_WORKERS", "3")
+        monkeypatch.setenv("TVT_PIPELINE_WINDOW", "7")
+        invalidate_settings_cache()
+        try:
+            meta = VideoMeta(width=64, height=48, num_frames=4)
+            enc = GopShardEncoder(meta, qp=27)
+            assert enc.pack_workers == 3
+            assert enc.pipeline_window == 7
+            # explicit constructor args beat the config tier
+            enc2 = GopShardEncoder(meta, qp=27, pack_workers=2,
+                                   pipeline_window=5)
+            assert enc2.pack_workers == 2
+            assert enc2.pipeline_window == 5
+        finally:
+            monkeypatch.delenv("TVT_PACK_WORKERS")
+            monkeypatch.delenv("TVT_PIPELINE_WINDOW")
+            invalidate_settings_cache()
+
+    def test_pack_gop_slices_planes_matches_thunk_path(self):
+        # pack_gop_slices_planes is the serial/pooled convenience entry
+        # over the same thunks collect_wave submits; pin them together
+        # so the wrapper cannot drift from the live path.
+        import concurrent.futures as cf
+
+        import jax.numpy as jnp
+
+        from thinvids_tpu.codecs.h264 import jaxinter
+        from thinvids_tpu.codecs.h264.encoder import (
+            gop_slice_thunks_planes, pack_gop_slices_planes)
+        from thinvids_tpu.codecs.h264.headers import PPS, SPS
+        from thinvids_tpu.parallel.dispatch import _unflatten_gop
+
+        w, h, n = 64, 48, 4
+        frames = _make_frames(n, seed=5)
+        ys = jnp.asarray(np.stack([f.y for f in frames]))
+        us = jnp.asarray(np.stack([f.u for f in frames]))
+        vs = jnp.asarray(np.stack([f.v for f in frames]))
+        mv8, flat = jaxinter.encode_gop_planes(ys, us, vs, jnp.asarray(27),
+                                               mbw=4, mbh=3)
+        intra, planes = _unflatten_gop(np.asarray(flat), np.asarray(mv8),
+                                       n, 4, 3)
+        sps, pps = SPS(width=w, height=h), PPS(init_qp=27)
+        serial = pack_gop_slices_planes(intra, planes, n, 4, 3, sps, pps,
+                                        27, idr_pic_id=0)
+        thunks = gop_slice_thunks_planes(intra, planes, n, 4, 3, sps, pps,
+                                         27, idr_pic_id=0)
+        assert serial == [t() for t in thunks]
+        with cf.ThreadPoolExecutor(4) as pool:
+            pooled = pack_gop_slices_planes(intra, planes, n, 4, 3, sps,
+                                            pps, 27, idr_pic_id=0,
+                                            pool=pool)
+        assert pooled == serial

@@ -493,9 +493,20 @@ class ApiServer:
                 base, _ext = os.path.splitext(job.input_path)
                 out = base + ".stamped.y4m"
                 write_y4m(out, meta, stamped)
-                co.add_job(out, meta=probe_video(out), auto_start=False)
-                co.activity.emit("stamp", f"stamped copy at {out}",
-                                 job_id=job_id)
+                # Dedup on the target path: a repeated POST /stamp_job
+                # refreshes the stamped file but must not register the
+                # same .stamped.y4m as a second job.
+                existing = next((j for j in co.store.list()
+                                 if j.input_path == out), None)
+                if existing is None:
+                    co.add_job(out, meta=probe_video(out),
+                               auto_start=False)
+                    co.activity.emit("stamp", f"stamped copy at {out}",
+                                     job_id=job_id)
+                else:
+                    co.activity.emit(
+                        "stamp", f"stamped copy at {out} refreshed "
+                        f"(already job {existing.id[:8]})", job_id=job_id)
             except Exception as exc:     # noqa: BLE001 - record & restore
                 co.activity.emit("error", f"stamp failed: {exc}",
                                  job_id=job_id)
@@ -513,6 +524,15 @@ class ApiServer:
         metrics = {w.host: dict(w.metrics, last_seen=w.last_seen)
                    for w in self.coordinator.registry.all()}
         out: dict[str, Any] = {"metrics": metrics}
+        # Host encode-stage breakdown (dispatch / device wait / fetch /
+        # sparse unpack / unflatten / pack / concat wall-clock ms) for
+        # every live encoder in this process. Read through sys.modules:
+        # if no encoder ever ran here (e.g. a pure-manager node), don't
+        # drag jax in just to report an empty dict.
+        import sys as _sys
+
+        disp = _sys.modules.get("thinvids_tpu.parallel.dispatch")
+        out["stage_ms"] = disp.stage_snapshot() if disp is not None else {}
         if self.work is not None:
             out["work"] = self.work.snapshot()
         return 200, out

@@ -148,9 +148,27 @@ class LocalExecutor:
         stage[0] = "encode"
         target_kbps = float(settings.get("target_bitrate_kbps", 0.0))
         if str(settings.rc_mode) == "vbr2pass" and target_kbps > 0:
-            return self._encode_vbr2pass(job, token, enc, frames,
-                                         settings, meta, target_kbps)
-        return self._encode_with_retry(job, token, enc, frames, settings)
+            segments = self._encode_vbr2pass(job, token, enc, frames,
+                                             settings, meta, target_kbps)
+        else:
+            segments = self._encode_with_retry(job, token, enc, frames,
+                                               settings)
+        self._emit_stage_breakdown(job, enc)
+        return segments
+
+    def _emit_stage_breakdown(self, job: Job, enc) -> None:
+        """Record the encoder's host-stage wall-clock breakdown (wave
+        dispatch / device wait / D2H fetch / sparse unpack / unflatten /
+        CAVLC pack / concat) in the job's activity feed — the per-job
+        counterpart of /metrics_snapshot's live aggregate."""
+        stages = getattr(enc, "stages", None)
+        if stages is None:
+            return
+        import json
+
+        self.coordinator.activity.emit(
+            "encode", "stage_ms " + json.dumps(stages.snapshot()),
+            job_id=job.id, host=self.host)
 
     @staticmethod
     def _maybe_trace(settings, job: Job):

@@ -19,6 +19,7 @@ Mode policy (keeps macroblock rows data-parallel for the TPU scan):
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import numpy as np
 
@@ -63,15 +64,18 @@ class FrameLevels:
     """Quantized level arrays for one frame, MB raster order (nmb = mbw*mbh).
 
     This is the compute→pack interface; the JAX path produces the same
-    structure. All zig-zag ordered as the packer expects.
+    structure. All zig-zag ordered as the packer expects. Level arrays
+    may be int32 or int16 (CAVLC levels fit int16 at every legal QP;
+    the transfer paths hand the packer int16 views and the native layer
+    packs them without a widening copy).
     """
 
     luma_mode: np.ndarray    # (nmb,) int32
     chroma_mode: np.ndarray  # (nmb,) int32
-    luma_dc: np.ndarray      # (nmb, 16) int32
-    luma_ac: np.ndarray      # (nmb, 16, 15) int32, z-scan block order
-    chroma_dc: np.ndarray    # (nmb, 2, 4) int32, raster DC order (Cb, Cr)
-    chroma_ac: np.ndarray    # (nmb, 2, 4, 15) int32
+    luma_dc: np.ndarray      # (nmb, 16)
+    luma_ac: np.ndarray      # (nmb, 16, 15), z-scan block order
+    chroma_dc: np.ndarray    # (nmb, 2, 4), raster DC order (Cb, Cr)
+    chroma_ac: np.ndarray    # (nmb, 2, 4, 15)
 
 
 def _mode_policy(mbw: int, mbh: int) -> tuple[np.ndarray, np.ndarray]:
@@ -371,43 +375,66 @@ def encode_gop(frames: list[Frame], meta: VideoMeta, qp: int = 27,
     return stream
 
 
-def _pack_gop_common(intra, pack_p, num_frames: int, mbw: int, mbh: int,
-                     sps: SPS, pps: PPS, qp: int, idr_pic_id: int,
-                     with_headers: bool) -> list[bytes]:
-    """Shared host half of GOP entropy packing: IDR slice from blocked
-    intra levels + one P slice per remaining frame via `pack_p(i,
-    frame_num)`. Every GOP-pack entry point funnels through here so the
-    bit-identity contract between paths cannot drift in the IDR/header
-    logic."""
+def _gop_slice_thunks(intra, pack_p, num_frames: int, mbw: int, mbh: int,
+                      sps: SPS, pps: PPS, qp: int, idr_pic_id: int,
+                      with_headers: bool) -> list:
+    """Per-slice pack closures for one GOP (IDR thunk first, then one
+    per P frame). A GOP's slices are independent bit-strings until the
+    final concat, so callers may run the thunks on a thread pool (the
+    native packer releases the GIL for the C call); running them in
+    order serially yields the same bytes. Every GOP-pack entry point
+    funnels through here so the bit-identity contract between paths
+    cannot drift in the IDR/header logic."""
     il_dc, il_ac, ic_dc, ic_ac = intra
     luma_mode, chroma_mode = _mode_policy(mbw, mbh)
     intra_levels = FrameLevels(
         luma_mode=luma_mode, chroma_mode=chroma_mode,
         luma_dc=il_dc, luma_ac=il_ac, chroma_dc=ic_dc, chroma_ac=ic_ac)
-    nals = []
     head = sps.to_nal() + pps.to_nal() if with_headers else b""
-    nals.append(head + pack_slice(intra_levels, mbw, mbh, sps, pps, qp,
-                                  frame_num=0, idr=True,
-                                  idr_pic_id=idr_pic_id % 65536))
+
+    def pack_idr():
+        return head + pack_slice(intra_levels, mbw, mbh, sps, pps, qp,
+                                 frame_num=0, idr=True,
+                                 idr_pic_id=idr_pic_id % 65536)
+
+    thunks = [pack_idr]
     for i in range(num_frames - 1):
-        nals.append(pack_p(i, (i + 1) % 256))
-    return nals
+        thunks.append(functools.partial(pack_p, i, (i + 1) % 256))
+    return thunks
 
 
-def pack_gop_slices_planes(intra, planes, num_frames: int, mbw: int,
-                           mbh: int, sps: SPS, pps: PPS, qp: int,
-                           idr_pic_id: int,
-                           with_headers: bool = True) -> list[bytes]:
-    """Entropy-pack one GOP whose P frames arrive as PLANE-layout level
-    arrays (the sharded transfer format, jaxinter.encode_gop_planes):
-    planes = (mv8 (F-1,nmb,2) int8, luma planes (F-1,H,W) int16,
-    u_dc/v_dc (F-1,nmb,4) int16, u_ac/v_ac (F-1,H/2,W/2) int16).
-    The intra frame stays blocked (jaxcore._intra_core emits blocked).
-    Bit-identical to pack_gop_slices on the equivalent blocked arrays."""
+def run_slice_thunks(thunks: list, pool=None) -> list[bytes]:
+    """Evaluate slice-pack thunks in slice order; with `pool` (any
+    Executor) the packs run concurrently, without it serially — the
+    resulting bytes are identical either way."""
+    if pool is None or len(thunks) <= 1:
+        return [t() for t in thunks]
+    return [f.result() for f in [pool.submit(t) for t in thunks]]
+
+
+def _pack_gop_common(intra, pack_p, num_frames: int, mbw: int, mbh: int,
+                     sps: SPS, pps: PPS, qp: int, idr_pic_id: int,
+                     with_headers: bool, pool=None) -> list[bytes]:
+    """Shared host half of GOP entropy packing: IDR slice from blocked
+    intra levels + one P slice per remaining frame via `pack_p(i,
+    frame_num)`, optionally fanned across `pool` at slice granularity."""
+    return run_slice_thunks(
+        _gop_slice_thunks(intra, pack_p, num_frames, mbw, mbh, sps, pps,
+                          qp, idr_pic_id, with_headers), pool)
+
+
+def gop_slice_thunks_planes(intra, planes, num_frames: int, mbw: int,
+                            mbh: int, sps: SPS, pps: PPS, qp: int,
+                            idr_pic_id: int,
+                            with_headers: bool = True) -> list:
+    """Per-slice pack thunks for one PLANE-layout GOP (see
+    pack_gop_slices_planes for the array contract). dispatch.collect_wave
+    submits these so slices from ALL of a wave's GOPs pack concurrently
+    on the pack pool instead of GOP-by-GOP."""
     from . import inter as inter_mod
 
     mv8, lp, udc, vdc, uac, vac = planes
-    return _pack_gop_common(
+    return _gop_slice_thunks(
         intra,
         lambda i, fn: inter_mod.pack_p_slice_plane(
             mv8[i], lp[i], udc[i], vdc[i], uac[i], vac[i], mbw, mbh,
@@ -415,9 +442,24 @@ def pack_gop_slices_planes(intra, planes, num_frames: int, mbw: int,
         num_frames, mbw, mbh, sps, pps, qp, idr_pic_id, with_headers)
 
 
+def pack_gop_slices_planes(intra, planes, num_frames: int, mbw: int,
+                           mbh: int, sps: SPS, pps: PPS, qp: int,
+                           idr_pic_id: int, with_headers: bool = True,
+                           pool=None) -> list[bytes]:
+    """Entropy-pack one GOP whose P frames arrive as PLANE-layout level
+    arrays (the sharded transfer format, jaxinter.encode_gop_planes):
+    planes = (mv8 (F-1,nmb,2) int8, luma planes (F-1,H,W) int16,
+    u_dc/v_dc (F-1,nmb,4) int16, u_ac/v_ac (F-1,H/2,W/2) int16).
+    The intra frame stays blocked (jaxcore._intra_core emits blocked).
+    Bit-identical to pack_gop_slices on the equivalent blocked arrays."""
+    return run_slice_thunks(
+        gop_slice_thunks_planes(intra, planes, num_frames, mbw, mbh, sps,
+                                pps, qp, idr_pic_id, with_headers), pool)
+
+
 def pack_gop_slices(intra, pouts, num_frames: int, mbw: int, mbh: int,
                     sps: SPS, pps: PPS, qp: int, idr_pic_id: int,
-                    with_headers: bool = True) -> list[bytes]:
+                    with_headers: bool = True, pool=None) -> list[bytes]:
     """Entropy-pack one GOP's slices from BLOCKED device level arrays
     (the single-device encode_gop path).
 
@@ -433,4 +475,5 @@ def pack_gop_slices(intra, pouts, num_frames: int, mbw: int, mbh: int,
         lambda i, fn: inter_mod.pack_p_slice(
             mv[i], l16[i], cdc[i], cac[i], mbw, mbh, sps, pps, qp,
             frame_num=fn),
-        num_frames, mbw, mbh, sps, pps, qp, idr_pic_id, with_headers)
+        num_frames, mbw, mbh, sps, pps, qp, idr_pic_id, with_headers,
+        pool=pool)

@@ -551,7 +551,9 @@ def _unpack_levels(flat: np.ndarray, mbw: int, mbh: int) -> FrameLevels:
     nmb = mbw * mbh
     sizes = (nmb * 16, nmb * 16 * 15, nmb * 2 * 4, nmb * 2 * 4 * 15)
     offs = np.cumsum((0,) + sizes)
-    flat = flat.astype(np.int32)
+    # keep the transfer dtype: int16 feeds the zero-copy native entry
+    # (cavlc_pack_islice16), int32 the original one — no widening here
+    flat = np.asarray(flat)
     luma_mode, chroma_mode = _mode_policy(mbw, mbh)
     return FrameLevels(
         luma_mode=luma_mode,

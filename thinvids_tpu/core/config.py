@@ -54,6 +54,12 @@ DEFAULT_SETTINGS: dict[str, Any] = {
     "software_fallback": True,       # pure-JAX CPU path when no TPU
     "profile_dir": "",               # non-empty: jax.profiler trace of
                                      # the encode stage lands here
+    # host wave pipeline (parallel/dispatch.py): slice-granular CAVLC
+    # pack threads (0 = os.cpu_count()) and the in-flight wave window.
+    # Deliberately independent: the pack pool sizes to the host's cores,
+    # the window to device queue depth / HBM budget.
+    "pack_workers": 0,
+    "pipeline_window": 4,
     # liveness / watchdog budgets (seconds)
     "metrics_ttl_s": 15.0,
     "active_window_s": 5.0,
@@ -136,6 +142,8 @@ _CLAMPS: dict[str, Callable[[Any], Any]] = {
     if as_int(v, 1080) in (480, 576, 720, 1080, 2160)
     else 1080,
     "rc_mode": lambda v: str(v) if str(v) in ("cqp", "vbr2pass") else "cqp",
+    "pack_workers": lambda v: min(256, max(0, as_int(v, 0))),
+    "pipeline_window": lambda v: min(64, max(1, as_int(v, 4))),
     "target_bitrate_kbps": lambda v: min(500_000.0, max(0.0, as_float(v, 0.0))),
     "large_file_behavior": lambda v: str(v)
     if str(v) in ("reject", "direct", "nfs")

@@ -462,10 +462,18 @@ def probe_mp4_header(path) -> dict:
                 size = struct.unpack(">Q", fp.read(8))[0]
                 hdr_len = 16
             elif size == 0:
-                size = hdr_len if kind != b"moov" else None
+                # ISO BMFF: size 0 = box extends to end of file. A
+                # non-moov to-EOF box means no moov can follow (the old
+                # 0-byte seek here re-parsed the box's own payload as
+                # headers — a near-endless walk on multi-GB files).
+                if kind != b"moov":
+                    break
+                size = None
             if kind == b"moov":
                 moov_body = fp.read() if size is None \
                     else fp.read(size - hdr_len)
+                break
+            if size < hdr_len:          # malformed: would seek backwards
                 break
             fp.seek(size - hdr_len, 1)
     if moov_body is None:

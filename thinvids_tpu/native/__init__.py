@@ -66,7 +66,7 @@ def _build_and_load() -> ctypes.CDLL:
                 os.makedirs(_BUILD_DIR, exist_ok=True)
                 tmp = _SO + ".tmp"
                 subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
                      _SRC, "-o", tmp],
                     check=True, capture_output=True, timeout=120)
                 os.replace(tmp, _SO)
@@ -76,14 +76,24 @@ def _build_and_load() -> ctypes.CDLL:
             raise RuntimeError(_load_failed) from exc
 
         lib.cavlc_init_tables.argtypes = [ctypes.c_void_p] * 5
-        lib.cavlc_pack_islice.restype = ctypes.c_int64
-        lib.cavlc_pack_islice.argtypes = [
+        _islice_sig = [
             ctypes.c_void_p, ctypes.c_int32,            # header bytes, bitlen
             ctypes.c_void_p, ctypes.c_void_p,           # modes
             ctypes.c_void_p, ctypes.c_void_p,           # luma dc/ac
             ctypes.c_void_p, ctypes.c_void_p,           # chroma dc/ac
             ctypes.c_int32, ctypes.c_int32,             # mbw, mbh
             ctypes.c_void_p, ctypes.c_int64,            # out, cap
+        ]
+        lib.cavlc_pack_islice.restype = ctypes.c_int64
+        lib.cavlc_pack_islice.argtypes = _islice_sig
+        lib.cavlc_pack_islice16.restype = ctypes.c_int64
+        lib.cavlc_pack_islice16.argtypes = _islice_sig
+        lib.cavlc_sparse_unpack2.restype = ctypes.c_int64
+        lib.cavlc_sparse_unpack2.argtypes = [
+            ctypes.c_int32, ctypes.c_int32,             # nblk, nval
+            ctypes.c_void_p, ctypes.c_void_p,           # bitmap, bmask16
+            ctypes.c_void_p,                            # vals
+            ctypes.c_void_p, ctypes.c_int64,            # out, L
         ]
         lib.cavlc_init_inter.argtypes = [ctypes.c_void_p]
         lib.cavlc_pack_pslice.restype = ctypes.c_int64
@@ -133,29 +143,39 @@ def pack_islice(header_bytes: bytes, header_bit_len: int,
                 luma_dc: np.ndarray, luma_ac: np.ndarray,
                 chroma_dc: np.ndarray, chroma_ac: np.ndarray,
                 mbw: int, mbh: int) -> bytes:
-    """Pack one I-slice (header bits + MB layer) and return the EBSP payload."""
+    """Pack one I-slice (header bits + MB layer) and return the EBSP payload.
+
+    When all four level arrays arrive as int16 (the flat transfer layout's
+    views, parallel/dispatch._unflatten_gop) they go to the zero-copy
+    `cavlc_pack_islice16` entry; anything else is widened to int32 and
+    packed through the original entry. Identical bits either way.
+    """
     lib = _build_and_load()
     nmb = mbw * mbh
+    use16 = all(getattr(a, "dtype", None) == np.int16
+                for a in (luma_dc, luma_ac, chroma_dc, chroma_ac))
+    lvl = np.int16 if use16 else np.int32
 
-    def prep(a, shape):
-        a = np.ascontiguousarray(a, np.int32)
+    def prep(a, shape, dtype=np.int32):
+        a = np.ascontiguousarray(a, dtype)
         if a.shape != shape:
             raise ValueError(f"bad array shape {a.shape}, want {shape}")
         return a
 
     luma_mode = prep(luma_mode, (nmb,))
     chroma_mode = prep(chroma_mode, (nmb,))
-    luma_dc = prep(luma_dc, (nmb, 16))
-    luma_ac = prep(luma_ac, (nmb, 16, 15))
-    chroma_dc = prep(chroma_dc, (nmb, 2, 4))
-    chroma_ac = prep(chroma_ac, (nmb, 2, 4, 15))
+    luma_dc = prep(luma_dc, (nmb, 16), lvl)
+    luma_ac = prep(luma_ac, (nmb, 16, 15), lvl)
+    chroma_dc = prep(chroma_dc, (nmb, 2, 4), lvl)
+    chroma_ac = prep(chroma_ac, (nmb, 2, 4, 15), lvl)
 
     # CAVLC worst case ≈ 28 bits/coeff × 384 coeffs ≈ 1.4 KB per MB (plus
     # emulation-prevention expansion); 4 KB/MB is a safe ceiling.
     cap = max(8192, nmb * 4096)
     out = np.empty(cap, np.uint8)
     hdr = np.frombuffer(header_bytes, np.uint8)
-    n = lib.cavlc_pack_islice(
+    entry = lib.cavlc_pack_islice16 if use16 else lib.cavlc_pack_islice
+    n = entry(
         hdr.ctypes.data, header_bit_len,
         luma_mode.ctypes.data, chroma_mode.ctypes.data,
         luma_dc.ctypes.data, luma_ac.ctypes.data,
@@ -246,3 +266,28 @@ def pack_pslice(header_bytes: bytes, header_bit_len: int, mv: np.ndarray,
     if n < 0:
         raise RuntimeError(f"native packer failed ({n})")
     return out[:n].tobytes()
+
+
+def block_sparse_unpack2(nblk: int, nval: int, bitmap: np.ndarray,
+                         bmask16: np.ndarray, vals: np.ndarray,
+                         L: int) -> np.ndarray:
+    """Native inverse of jaxcore._block_sparse_pack2 → flat int16 levels.
+
+    One memset + one O(nval) scatter instead of numpy's three boolean
+    index passes over the full coefficient vector (jaxcore keeps the
+    pure-Python implementation as the no-compiler fallback and the
+    parity reference)."""
+    lib = _build_and_load()
+    bitmap = np.ascontiguousarray(bitmap, np.uint8)
+    bmask16 = np.ascontiguousarray(bmask16, np.uint16)
+    vals = np.ascontiguousarray(vals, np.int8)
+    NB = -(-L // 16)
+    # np.zeros = calloc: the native scatter relies on the buffer being
+    # zeroed, and lazy OS zero-pages beat an explicit 50 MB/GOP memset
+    out = np.zeros(NB * 16, np.int16)
+    rc = lib.cavlc_sparse_unpack2(
+        int(nblk), int(nval), bitmap.ctypes.data, bmask16.ctypes.data,
+        vals.ctypes.data, out.ctypes.data, L)
+    if rc != 0:
+        raise ValueError("sparse level stream inconsistent with counts")
+    return out[:L]

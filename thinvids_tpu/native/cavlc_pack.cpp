@@ -27,16 +27,26 @@ static bool g_tables_ready = false;
 struct BitWriter {
   std::vector<uint8_t> buf;
   uint64_t acc = 0;
-  int nbits = 0;
+  int nbits = 0;  // bits pending in acc; < 32 between writes
 
-  void write(uint32_t value, int n) {
+  // n <= 32 (enforced by all call sites); acc holds < 32 bits on entry,
+  // so the shift never exceeds 63 bits. Flushing whole 32-bit words
+  // takes the buffer-append branch once per 4 output bytes instead of
+  // once per byte — this writer is the innermost loop of the pack.
+  inline void write(uint32_t value, int n) {
     acc = (acc << n) | value;
     nbits += n;
-    while (nbits >= 8) {
-      nbits -= 8;
-      buf.push_back(static_cast<uint8_t>((acc >> nbits) & 0xFF));
+    if (nbits >= 32) {
+      nbits -= 32;
+      const uint32_t w = static_cast<uint32_t>(acc >> nbits);
+      const size_t o = buf.size();
+      buf.resize(o + 4);
+      buf[o] = static_cast<uint8_t>(w >> 24);
+      buf[o + 1] = static_cast<uint8_t>(w >> 16);
+      buf[o + 2] = static_cast<uint8_t>(w >> 8);
+      buf[o + 3] = static_cast<uint8_t>(w);
+      acc &= (1ULL << nbits) - 1;
     }
-    acc &= (1ULL << nbits) - 1;
   }
   void ue(uint32_t v) {
     uint32_t code = v + 1;
@@ -48,11 +58,57 @@ struct BitWriter {
   void trailing() {
     write(1, 1);
     if (nbits % 8) write(0, 8 - (nbits % 8));
+    while (nbits >= 8) {  // drain the word accumulator (byte-aligned now)
+      nbits -= 8;
+      buf.push_back(static_cast<uint8_t>(acc >> nbits));
+    }
+    acc = 0;
   }
 };
 
+// Precomputed level codes: g_lev_{len,bits}[suffix_len][level_code] for
+// level_code < 64 (covers every level the quantizer emits at practical
+// QPs) fold the prefix/suffix branch cascade into one table write.
+static uint32_t g_lev_bits[7][64];
+static uint8_t g_lev_len[7][64];
+
+static void build_level_table() {
+  for (int s = 0; s < 7; s++) {
+    for (uint32_t lc = 0; lc < 64; lc++) {
+      uint32_t bits;
+      int len;
+      if (s == 0) {
+        if (lc < 14) {
+          bits = 1;
+          len = (int)lc + 1;
+        } else if (lc < 30) {
+          bits = (1u << 4) | (lc - 14);
+          len = 19;
+        } else {
+          bits = (1u << 12) | (lc - 30);
+          len = 28;
+        }
+      } else {
+        const uint32_t prefix = lc >> s;
+        if (prefix < 15) {
+          bits = (1u << s) | (lc & ((1u << s) - 1));
+          len = (int)prefix + 1 + s;
+        } else {
+          bits = (1u << 12) | (lc - (15u << s));
+          len = 28;
+        }
+      }
+      g_lev_bits[s][lc] = bits;
+      g_lev_len[s][lc] = (uint8_t)len;
+    }
+  }
+}
+
 // Returns total_coeff; writes the residual block. coeffs: zig-zag order.
-static int encode_residual(BitWriter& bw, const int32_t* coeffs, int n, int nc) {
+// Templated over the level dtype so the int16 transfer layout packs
+// without a widening copy (cavlc_pack_islice16 / the plane packers).
+template <typename T>
+static int encode_residual(BitWriter& bw, const T* coeffs, int n, int nc) {
   int positions[16];
   int total = 0;
   for (int i = 0; i < n; i++)
@@ -81,31 +137,26 @@ static int encode_residual(BitWriter& bw, const int32_t* coeffs, int n, int nc) 
   int suffix_len = (total > 10 && trailing < 3) ? 1 : 0;
   bool first = true;
   for (int k = total - trailing - 1; k >= 0; k--) {
-    int32_t level = coeffs[positions[k]];
-    int32_t mag = level < 0 ? -level : level;
+    const int32_t level = coeffs[positions[k]];
+    const int32_t mag = level < 0 ? -level : level;
     uint32_t level_code = (uint32_t)(mag - 1) * 2 + (level < 0 ? 1 : 0);
     if (first && trailing < 3) level_code -= 2;
     first = false;
-    if (suffix_len == 0) {
-      if (level_code < 14) {
-        bw.write(1, level_code + 1);
-      } else if (level_code < 30) {
-        bw.write(1, 15);
-        bw.write(level_code - 14, 4);
-      } else {
-        if (level_code - 30 >= (1u << 12)) return -3;  // exceeds baseline
-        bw.write(1, 16);
-        bw.write(level_code - 30, 12);
-      }
+    if (level_code < 64) {  // precomputed: single branch + single write
+      bw.write(g_lev_bits[suffix_len][level_code],
+               g_lev_len[suffix_len][level_code]);
+    } else if (suffix_len == 0) {
+      if (level_code - 30 >= (1u << 12)) return -3;  // exceeds baseline
+      bw.write((1u << 12) | (level_code - 30), 28);
     } else {
-      uint32_t prefix = level_code >> suffix_len;
+      const uint32_t prefix = level_code >> suffix_len;
       if (prefix < 15) {
-        bw.write(1, prefix + 1);
-        bw.write(level_code & ((1u << suffix_len) - 1), suffix_len);
+        bw.write((1u << suffix_len)
+                     | (level_code & ((1u << suffix_len) - 1)),
+                 (int)prefix + 1 + suffix_len);
       } else {
         if (level_code - (15u << suffix_len) >= (1u << 12)) return -3;
-        bw.write(1, 16);
-        bw.write(level_code - (15u << suffix_len), 12);
+        bw.write((1u << 12) | (level_code - (15u << suffix_len)), 28);
       }
     }
     if (suffix_len == 0) suffix_len = 1;
@@ -157,31 +208,19 @@ static int64_t emit_ebsp(const BitWriter& bw, uint8_t* out, int64_t out_cap) {
   return o;
 }
 
-}  // namespace
-
-extern "C" {
-
-void cavlc_init_tables(const int32_t* coeff_token, const int32_t* chroma_dc,
-                       const int32_t* total_zeros, const int32_t* tz_chroma,
-                       const int32_t* run_before) {
-  std::memcpy(g_coeff_token, coeff_token, sizeof(g_coeff_token));
-  std::memcpy(g_chroma_dc_token, chroma_dc, sizeof(g_chroma_dc_token));
-  std::memcpy(g_total_zeros, total_zeros, sizeof(g_total_zeros));
-  std::memcpy(g_tz_chroma, tz_chroma, sizeof(g_tz_chroma));
-  std::memcpy(g_run_before, run_before, sizeof(g_run_before));
-  g_tables_ready = true;
-}
-
 // Packs slice-header bits + all MB data + rbsp trailing, applies emulation
 // prevention. Returns EBSP byte length, or -1 on error / -2 if out_cap is
-// too small.
-int64_t cavlc_pack_islice(
+// too small. Templated over the level dtype: the sharded transfer hands
+// the host int16 views (cavlc_pack_islice16) and packing them directly
+// kills the ~4-array astype(int32) copy chain that used to run per GOP.
+template <typename T>
+static int64_t pack_islice_impl(
     const uint8_t* header_bytes, int32_t header_bit_len,
     const int32_t* luma_mode, const int32_t* chroma_mode,
-    const int32_t* luma_dc,    // nmb*16
-    const int32_t* luma_ac,    // nmb*16*15
-    const int32_t* chroma_dc,  // nmb*2*4
-    const int32_t* chroma_ac,  // nmb*2*4*15
+    const T* luma_dc,    // nmb*16
+    const T* luma_ac,    // nmb*16*15
+    const T* chroma_dc,  // nmb*2*4
+    const T* chroma_ac,  // nmb*2*4*15
     int32_t mbw, int32_t mbh, uint8_t* out, int64_t out_cap) {
   if (!g_tables_ready || mbw <= 0 || mbh <= 0) return -1;
   // z-scan order of 4x4 luma blocks within a MB: (bx, by)
@@ -212,9 +251,9 @@ int64_t cavlc_pack_islice(
   for (int my = 0; my < mbh; my++) {
     for (int mx = 0; mx < mbw; mx++) {
       const int mi = my * mbw + mx;
-      const int32_t* lac = luma_ac + (size_t)mi * 16 * 15;
-      const int32_t* cac = chroma_ac + (size_t)mi * 2 * 4 * 15;
-      const int32_t* cdc = chroma_dc + (size_t)mi * 2 * 4;
+      const T* lac = luma_ac + (size_t)mi * 16 * 15;
+      const T* cac = chroma_ac + (size_t)mi * 2 * 4 * 15;
+      const T* cdc = chroma_dc + (size_t)mi * 2 * 4;
 
       int cbp_luma = 0;
       for (int i = 0; i < 16 * 15 && !cbp_luma; i++)
@@ -272,6 +311,86 @@ int64_t cavlc_pack_islice(
   return emit_ebsp(bw, out, out_cap);
 }
 
+}  // namespace
+
+extern "C" {
+
+void cavlc_init_tables(const int32_t* coeff_token, const int32_t* chroma_dc,
+                       const int32_t* total_zeros, const int32_t* tz_chroma,
+                       const int32_t* run_before) {
+  std::memcpy(g_coeff_token, coeff_token, sizeof(g_coeff_token));
+  std::memcpy(g_chroma_dc_token, chroma_dc, sizeof(g_chroma_dc_token));
+  std::memcpy(g_total_zeros, total_zeros, sizeof(g_total_zeros));
+  std::memcpy(g_tz_chroma, tz_chroma, sizeof(g_tz_chroma));
+  std::memcpy(g_run_before, run_before, sizeof(g_run_before));
+  build_level_table();
+  g_tables_ready = true;
+}
+
+int64_t cavlc_pack_islice(
+    const uint8_t* header_bytes, int32_t header_bit_len,
+    const int32_t* luma_mode, const int32_t* chroma_mode,
+    const int32_t* luma_dc, const int32_t* luma_ac,
+    const int32_t* chroma_dc, const int32_t* chroma_ac,
+    int32_t mbw, int32_t mbh, uint8_t* out, int64_t out_cap) {
+  return pack_islice_impl(header_bytes, header_bit_len, luma_mode,
+                          chroma_mode, luma_dc, luma_ac, chroma_dc,
+                          chroma_ac, mbw, mbh, out, out_cap);
+}
+
+// int16 entry: packs the flat transfer layout's level views directly.
+int64_t cavlc_pack_islice16(
+    const uint8_t* header_bytes, int32_t header_bit_len,
+    const int32_t* luma_mode, const int32_t* chroma_mode,
+    const int16_t* luma_dc, const int16_t* luma_ac,
+    const int16_t* chroma_dc, const int16_t* chroma_ac,
+    int32_t mbw, int32_t mbh, uint8_t* out, int64_t out_cap) {
+  return pack_islice_impl(header_bytes, header_bit_len, luma_mode,
+                          chroma_mode, luma_dc, luma_ac, chroma_dc,
+                          chroma_ac, mbw, mbh, out, out_cap);
+}
+
+// Host inverse of jaxcore._block_sparse_pack2: bitmap (1 bit/16-coeff
+// block, big-endian within bytes) + per-live-block uint16 lane masks +
+// the packed nonzero values -> flat int16 levels in `out` (L coeffs; the
+// caller allocates ceil(L/16)*16 so the tail block never lands out of
+// bounds). The numpy version built three boolean index passes over the
+// full vector (~25 M coeffs per 1080p GOP); this is one O(nval)
+// scatter. `out` MUST arrive zeroed — the Python wrapper hands a fresh
+// np.zeros (calloc) buffer, so the zero fill is lazy OS zero-pages
+// instead of a 50 MB memset per GOP. Returns 0, or -1 when the streams
+// disagree with the counts (corrupt transfer).
+int64_t cavlc_sparse_unpack2(
+    int32_t nblk, int32_t nval,
+    const uint8_t* bitmap, const uint16_t* bmask16, const int8_t* vals,
+    int16_t* out, int64_t L) {
+  const int64_t NB = (L + 15) / 16;
+  int32_t bi = 0, vi = 0;
+  int64_t b = 0;
+  for (; b < NB && bi < nblk; b++) {
+    if (!(bitmap[b >> 3] & (0x80u >> (b & 7)))) continue;
+    uint32_t m = bmask16[bi++];
+    if (vi + __builtin_popcount(m) > nval) return -1;
+    int16_t* o = out + b * 16;
+    while (m) {
+      const int k = __builtin_ctz(m);
+      m &= m - 1;
+      o[k] = vals[vi++];
+    }
+  }
+  if (bi != nblk || vi != nval) return -1;
+  // Any set bit AFTER the nblk-th live block is a corrupt bitmap too —
+  // it must fail loudly like the numpy reference, not decode those
+  // blocks as silent zeros. Byte-granular tail scan.
+  const int64_t nbytes = (NB + 7) / 8;
+  int64_t byte = b >> 3;
+  if (byte < nbytes) {
+    if (bitmap[byte] & (0xFFu >> (b & 7))) return -1;
+    for (byte++; byte < nbytes; byte++)
+      if (bitmap[byte]) return -1;
+  }
+  return 0;
+}
 
 // ---- P-slice support -------------------------------------------------------
 

@@ -9,11 +9,24 @@ frames once P-frames land), and the quantized levels return to host for
 entropy packing. Encoded segments concat in index order; bit-identity with
 the single-device encode is asserted by tests/test_parallel.py on an
 8-device virtual mesh.
+
+Host side, the pipeline is instrumented per stage (StageProfile): every
+wave's dispatch / device wait / D2H fetch / sparse unpack / unflatten /
+CAVLC pack / concat wall-clock accumulates on the encoder and is exported
+through bench.py (`stage_ms`) and the API's /metrics_snapshot. The
+entropy pack fans out at SLICE granularity across a per-encoder pool
+sized by `pack_workers` (TVT_PACK_WORKERS; default: all cores; threads
+spawn on demand and retire with the encoder), decoupled from the
+in-flight wave window `pipeline_window` (TVT_PIPELINE_WINDOW).
 """
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import os
+import threading
+import time
 
 import numpy as np
 
@@ -21,11 +34,12 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..core.config import get_settings
 from ..core.devices import shard_map
 from ..core.types import EncodedSegment, Frame, GopSpec, SegmentPlan, VideoMeta
-from ..codecs.h264.encoder import pack_slice
-from ..codecs.h264.headers import PPS, SPS
 from ..codecs.h264 import jaxcore
+from ..codecs.h264.encoder import gop_slice_thunks_planes, pack_slice
+from ..codecs.h264.headers import PPS, SPS
 # Per-MB flat sizes, owned by jaxinter next to the layout they describe
 # (intra: luma_dc 16 + luma_ac 240 + chroma 128; P plane layout: luma
 # plane 256 + chroma DC 8 + chroma AC planes 128 — MVs ride separately
@@ -38,6 +52,87 @@ from .planner import plan_segments
 def default_mesh(devices=None) -> Mesh:
     devices = list(jax.devices()) if devices is None else list(devices)
     return Mesh(np.array(devices), ("gop",))
+
+
+# ---- host-stage wall-clock instrumentation --------------------------------
+
+#: canonical stage keys, in pipeline order
+STAGE_NAMES = ("dispatch", "device_wait", "fetch", "sparse_unpack",
+               "unflatten", "pack", "concat")
+
+
+class StageProfile:
+    """Thread-safe per-stage wall-clock accumulator for the host half of
+    the wave pipeline. Stages overlap across pool threads, so per-stage
+    sums can exceed elapsed time — they answer "where do host cycles
+    go", not "what is the critical path".
+
+    `mirror` (the process-wide cumulative profile) receives every add
+    too, so /metrics_snapshot keeps a job's totals after its encoder is
+    garbage-collected; reset() only clears THIS profile (bench resets
+    per timed pass without zeroing the process counters)."""
+
+    def __init__(self, mirror: "StageProfile | None" = None) -> None:
+        self._lock = threading.Lock()
+        self._ms = {k: 0.0 for k in STAGE_NAMES}
+        self._waves = 0
+        self._mirror = mirror
+
+    def add(self, stage: str, seconds: float) -> None:
+        with self._lock:
+            self._ms[stage] = self._ms.get(stage, 0.0) + seconds * 1e3
+        if self._mirror is not None:
+            self._mirror.add(stage, seconds)
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - t0)
+
+    def count_wave(self) -> None:
+        with self._lock:
+            self._waves += 1
+        if self._mirror is not None:
+            self._mirror.count_wave()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {k: round(v, 2) for k, v in self._ms.items()}
+            out["waves"] = self._waves
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            for k in self._ms:
+                self._ms[k] = 0.0
+            self._waves = 0
+
+
+#: process-cumulative stage totals (every encoder mirrors into this)
+_TOTALS = StageProfile()
+
+
+def stage_snapshot() -> dict:
+    """Process-cumulative stage_ms across every GopShardEncoder that ran
+    here (the /metrics_snapshot exporter — running jobs' waves land as
+    they complete, and finished jobs' totals persist)."""
+    return _TOTALS.snapshot()
+
+
+def _sparse_unpack2_host(nblk: int, nval: int, bitmap, bmask16, vals,
+                         L: int) -> np.ndarray:
+    """Two-tier sparse unpack: native scatter when a compiler exists,
+    jaxcore's numpy reference otherwise (identical output — tested)."""
+    from .. import native as native_mod
+
+    if native_mod.available():
+        return native_mod.block_sparse_unpack2(nblk, nval, bitmap,
+                                               bmask16, vals, L)
+    return jaxcore._block_sparse_unpack2(nblk, nval, bitmap, bmask16,
+                                         vals, L)
 
 
 def _flat_levels(y, u, v, qp, mbw, mbh):
@@ -75,37 +170,75 @@ def _per_gop_dense(y, u, v, qp, mbw: int, mbh: int, dtype):
     return flat.astype(dtype)
 
 
-def _unflatten_gop(flat: np.ndarray, mv8: np.ndarray, num_frames: int,
-                   mbw: int, mbh: int):
-    """Host inverse of jaxinter.encode_gop_planes: split the flat int16
-    vector into (intra blocked arrays, P plane views). Every P-frame
-    array is a VIEW — the plane->blocked scan happens inside the native
-    packer (cavlc_pack_pslice_plane), so no relayout pass runs here."""
+def _unflatten_intra(seg: np.ndarray, nmb: int):
+    """Flat intra segment (nmb * 384, layout il_dc|il_ac|ic_dc|ic_ac) →
+    blocked VIEWS. The int16 views feed cavlc_pack_islice16 directly —
+    the old astype(int32) chain here allocated ~4 copies of the intra
+    levels per GOP on the critical path."""
+    o = nmb * 16
+    il_dc = seg[:o].reshape(nmb, 16)
+    il_ac = seg[o:o + nmb * 240].reshape(nmb, 16, 15)
+    o += nmb * 240
+    ic_dc = seg[o:o + nmb * 8].reshape(nmb, 2, 4)
+    o += nmb * 8
+    ic_ac = seg[o:o + nmb * 120].reshape(nmb, 2, 4, 15)
+    return il_dc, il_ac, ic_dc, ic_ac
+
+
+def _unflatten_p_planes(seg: np.ndarray, mv8: np.ndarray, num_frames: int,
+                        mbw: int, mbh: int):
+    """Flat P segment → plane VIEWS (the plane->blocked scan happens
+    inside the native packer, cavlc_pack_pslice_plane, so no relayout
+    pass runs on the host)."""
     nmb = mbw * mbh
     H, W = mbh * 16, mbw * 16
     hw2 = (H // 2) * (W // 2)
-    flat = np.asarray(flat)
-    o = 0
-    il_dc = flat[o:o + nmb * 16].reshape(nmb, 16).astype(np.int32)
-    o += nmb * 16
-    il_ac = flat[o:o + nmb * 240].reshape(nmb, 16, 15).astype(np.int32)
-    o += nmb * 240
-    ic_dc = flat[o:o + nmb * 8].reshape(nmb, 2, 4).astype(np.int32)
-    o += nmb * 8
-    ic_ac = flat[o:o + nmb * 120].reshape(nmb, 2, 4, 15).astype(np.int32)
-    o += nmb * 120
     F1 = num_frames - 1
-    lp = flat[o:o + F1 * H * W].reshape(F1, H, W)
+    o = 0
+    lp = seg[o:o + F1 * H * W].reshape(F1, H, W)
     o += F1 * H * W
-    udc = flat[o:o + F1 * nmb * 4].reshape(F1, nmb, 4)
+    udc = seg[o:o + F1 * nmb * 4].reshape(F1, nmb, 4)
     o += F1 * nmb * 4
-    vdc = flat[o:o + F1 * nmb * 4].reshape(F1, nmb, 4)
+    vdc = seg[o:o + F1 * nmb * 4].reshape(F1, nmb, 4)
     o += F1 * nmb * 4
-    uac = flat[o:o + F1 * hw2].reshape(F1, H // 2, W // 2)
+    uac = seg[o:o + F1 * hw2].reshape(F1, H // 2, W // 2)
     o += F1 * hw2
-    vac = flat[o:o + F1 * hw2].reshape(F1, H // 2, W // 2)
-    mv = np.asarray(mv8)
-    return ((il_dc, il_ac, ic_dc, ic_ac), (mv, lp, udc, vdc, uac, vac))
+    vac = seg[o:o + F1 * hw2].reshape(F1, H // 2, W // 2)
+    return (np.asarray(mv8), lp, udc, vdc, uac, vac)
+
+
+def _unflatten_gop(flat: np.ndarray, mv8: np.ndarray, num_frames: int,
+                   mbw: int, mbh: int):
+    """Host inverse of jaxinter.encode_gop_planes: split the flat int16
+    vector into (intra blocked arrays, P plane views). EVERY array is a
+    zero-copy view into `flat`."""
+    nmb = mbw * mbh
+    flat = np.asarray(flat)
+    o = nmb * _INTRA_MB
+    intra = _unflatten_intra(flat[:o], nmb)
+    planes = _unflatten_p_planes(flat[o:], mv8, num_frames, mbw, mbh)
+    return intra, planes
+
+
+def _unflatten_gop_parts(dense: np.ndarray, rest: np.ndarray,
+                         mv8: np.ndarray, num_frames: int,
+                         mbw: int, mbh: int):
+    """Sparse-path unflatten straight from the two transfer segments —
+    dense = [il_dc | ic_dc] (the hadamard DC prefix, _per_gop_sparse),
+    rest = [il_ac | ic_ac | P planes] — without first concatenating
+    them back into the full flat layout (which copied ~25 MB per 1080p
+    GOP). Views only."""
+    nmb = mbw * mbh
+    ndc, nlac = nmb * 16, nmb * 240
+    dense = np.asarray(dense)
+    rest = np.asarray(rest)
+    il_dc = dense[:ndc].reshape(nmb, 16)
+    ic_dc = dense[ndc:].reshape(nmb, 2, 4)
+    il_ac = rest[:nlac].reshape(nmb, 16, 15)
+    o = nlac + nmb * 120
+    ic_ac = rest[nlac:o].reshape(nmb, 2, 4, 15)
+    planes = _unflatten_p_planes(rest[o:], mv8, num_frames, mbw, mbh)
+    return (il_dc, il_ac, ic_dc, ic_ac), planes
 
 
 @functools.partial(jax.jit, static_argnames=("mbw", "mbh", "mesh"))
@@ -169,55 +302,59 @@ def _encode_wave_gop_dense(ys, us, vs, qps, *, mbw: int, mbh: int, mesh: Mesh,
 
 
 @functools.partial(jax.jit, static_argnames=("mbw", "mbh", "mesh"))
-def _encode_wave(ys, us, vs, qp, *, mbw: int, mbh: int, mesh: Mesh):
-    """ys: (G, F, H, W) uint8 sharded over `gop`.
+def _encode_wave(ys, us, vs, qps, *, mbw: int, mbh: int, mesh: Mesh):
+    """All-intra wave. ys: (G, F, H, W) uint8 sharded over `gop`; qps:
+    (G,) int32 per-GOP QP — the rate-control hook (this path used to
+    take one wave-wide scalar, silently encoding every GOP at base QP
+    regardless of `gop_qp` overrides).
 
     Returns per-frame sparse-packed levels (jaxcore._sparse_pack — ~10x
     fewer device→host bytes than raw int32) with leading (G, F) dims;
     the host checks the nnz/escape counts for the rare dense fallback.
     """
 
-    def per_gop(y_g, u_g, v_g):
-        # y_g: (1, F, H, W) — this device's GOP(s)
-        def per_frame(planes):
-            y, u, v = planes
-            return jaxcore._sparse_pack(_flat_levels(y, u, v, qp, mbw, mbh))
+    def per_gop(y_g, u_g, v_g, qp_g):
+        # y_g: (1, F, H, W) — this device's GOP(s); qp_g: (1,)
+        def one(y_f, u_f, v_f, qp1):
+            def per_frame(planes):
+                y, u, v = planes
+                return jaxcore._sparse_pack(
+                    _flat_levels(y, u, v, qp1, mbw, mbh))
 
-        def one(y_f, u_f, v_f):
             return jax.lax.map(per_frame, (y_f, u_f, v_f))
 
-        return jax.vmap(one)(y_g, u_g, v_g)               # each (1, F, ...)
+        return jax.vmap(one)(y_g, u_g, v_g, qp_g)         # each (1, F, ...)
 
     shard = shard_map(
         per_gop, mesh=mesh,
-        in_specs=(P("gop"), P("gop"), P("gop")),
+        in_specs=(P("gop"),) * 4,
         out_specs=(P("gop"),) * 6,
     )
-    return shard(ys, us, vs)
+    return shard(ys, us, vs, qps)
 
 
 @functools.partial(jax.jit, static_argnames=("mbw", "mbh", "mesh", "dtype"))
-def _encode_wave_dense(ys, us, vs, qp, *, mbw: int, mbh: int, mesh: Mesh,
+def _encode_wave_dense(ys, us, vs, qps, *, mbw: int, mbh: int, mesh: Mesh,
                        dtype):
     """Dense fallback: (G, F, L) levels in `dtype` (int16 covers the full
-    CAVLC level range)."""
+    CAVLC level range), at the same per-GOP QPs as the sparse pass."""
 
-    def per_gop(y_g, u_g, v_g):
-        def per_frame(planes):
-            y, u, v = planes
-            return _flat_levels(y, u, v, qp, mbw, mbh)
+    def per_gop(y_g, u_g, v_g, qp_g):
+        def one(y_f, u_f, v_f, qp1):
+            def per_frame(planes):
+                y, u, v = planes
+                return _flat_levels(y, u, v, qp1, mbw, mbh)
 
-        def one(y_f, u_f, v_f):
             return jax.lax.map(per_frame, (y_f, u_f, v_f))
 
-        return jax.vmap(one)(y_g, u_g, v_g).astype(dtype)
+        return jax.vmap(one)(y_g, u_g, v_g, qp_g).astype(dtype)
 
     shard = shard_map(
         per_gop, mesh=mesh,
-        in_specs=(P("gop"), P("gop"), P("gop")),
+        in_specs=(P("gop"),) * 4,
         out_specs=P("gop"),
     )
-    return shard(ys, us, vs)
+    return shard(ys, us, vs, qps)
 
 
 class GopShardEncoder:
@@ -225,7 +362,9 @@ class GopShardEncoder:
 
     def __init__(self, meta: VideoMeta, qp: int = 27, mesh: Mesh | None = None,
                  gop_frames: int = 32, max_segments: int = 200,
-                 inter: bool = True, gops_per_wave: int = 4):
+                 inter: bool = True, gops_per_wave: int = 4,
+                 pack_workers: int | None = None,
+                 pipeline_window: int | None = None):
         self.meta = meta
         self.qp = qp
         #: inter=True encodes each GOP as IDR + P frames (motion-coded);
@@ -241,7 +380,23 @@ class GopShardEncoder:
         self.sps = SPS(width=meta.width, height=meta.height,
                        fps_num=meta.fps_num, fps_den=meta.fps_den)
         self.pps = PPS(init_qp=qp)
-        self._qp_arr = jnp.asarray(qp)      # hoisted: one upload per clip
+        snap = get_settings()
+        #: slice-granular CAVLC pack threads (0/None in config = all
+        #: cores). Decoupled from the wave window: the pack pool sizes
+        #: to the HOST (cpu count), the window to device queue depth.
+        if pack_workers is None:
+            pack_workers = int(snap.get("pack_workers", 0) or 0)
+        self.pack_workers = int(pack_workers) or (os.cpu_count() or 2)
+        #: in-flight wave window: staged inputs + outputs of this many
+        #: waves stay alive at once (device queue x transfer overlap).
+        if pipeline_window is None:
+            pipeline_window = int(snap.get("pipeline_window", 0) or 0)
+        self.pipeline_window = int(pipeline_window) or self.PIPELINE_WINDOW
+        #: per-stage host wall-clock (bench `stage_ms`, /metrics_snapshot)
+        self.stages = StageProfile(mirror=_TOTALS)
+        #: eager so concurrent collect_wave threads never race a lazy
+        #: init; the executor spawns NO threads until first submit
+        self._pack_pool = self._new_pack_pool()
         #: Optional per-GOP QP overrides (rate control): gop index → qp.
         #: GOPs absent from the map encode at the base `qp`; slice
         #: headers carry the delta vs PPS init_qp.
@@ -328,61 +483,94 @@ class GopShardEncoder:
     def dispatch_wave(self, staged: tuple) -> tuple:
         """Enqueue one staged wave's device compute (async); returns an
         opaque pending handle for :meth:`collect_wave`."""
-        wave, ysd, usd, vsd, qpsd = staged
-        ph, pw = ysd.shape[2], ysd.shape[3]
-        mbh, mbw = ph // 16, pw // 16
-        if self.inter and self.num_devices == 1:
-            out = _encode_gop_single(ysd, usd, vsd, qpsd, mbw=mbw, mbh=mbh)
-        elif self.inter:
-            out = _encode_wave_gop(ysd, usd, vsd, qpsd, mbw=mbw, mbh=mbh,
+        with self.stages.stage("dispatch"):
+            wave, ysd, usd, vsd, qpsd = staged
+            ph, pw = ysd.shape[2], ysd.shape[3]
+            mbh, mbw = ph // 16, pw // 16
+            if self.inter and self.num_devices == 1:
+                out = _encode_gop_single(ysd, usd, vsd, qpsd, mbw=mbw,
+                                         mbh=mbh)
+            elif self.inter:
+                out = _encode_wave_gop(ysd, usd, vsd, qpsd, mbw=mbw, mbh=mbh,
+                                       mesh=self.mesh)
+            else:
+                out = _encode_wave(ysd, usd, vsd, qpsd, mbw=mbw, mbh=mbh,
                                    mesh=self.mesh)
-        else:
-            out = _encode_wave(ysd, usd, vsd, self._qp_arr, mbw=mbw,
-                               mbh=mbh, mesh=self.mesh)
-        for arr in out:
-            # Start the device->host copies now, overlapped with the next
-            # wave's compute (the transfer link has high latency — axon
-            # tunnels measure ~0.1-0.2 s per blocking fetch).
-            try:
-                arr.copy_to_host_async()
-            except Exception:       # noqa: BLE001 - best-effort prefetch
-                pass
-        return (wave, ysd, usd, vsd, qpsd, mbw, mbh, out)
+            for arr in out:
+                # Start the device->host copies now, overlapped with the
+                # next wave's compute (the transfer link has high latency
+                # — axon tunnels measure ~0.1-0.2 s per blocking fetch).
+                try:
+                    arr.copy_to_host_async()
+                except Exception:   # noqa: BLE001 - best-effort prefetch
+                    pass
+            return (wave, ysd, usd, vsd, qpsd, mbw, mbh, out)
+
+    def _new_pack_pool(self):
+        """This encoder's slice-pack pool (threads spawn on demand up
+        to pack_workers), or None for inline packing (pack_workers <=
+        1). Shut down when the encoder is garbage-collected — a
+        long-lived coordinator running many jobs must not accumulate
+        parked pack threads."""
+        if self.pack_workers <= 1:
+            return None
+        import concurrent.futures as cf
+        import weakref
+
+        pool = cf.ThreadPoolExecutor(self.pack_workers,
+                                     thread_name_prefix="tvt-pack")
+        weakref.finalize(self, pool.shutdown, False)
+        return pool
+
+    def _slice_pool(self):
+        return self._pack_pool
 
     def collect_wave(self, pending: tuple) -> list[EncodedSegment]:
         """Fetch one dispatched wave's levels (sparse, with the dense
-        fallback) and entropy-pack its GOPs on host."""
+        fallback) and entropy-pack its GOPs on host, fanning the pack
+        across the slice pool (all of the wave's slices at once)."""
         wave, ysd, usd, vsd, qpsd, mbw, mbh, out = pending
-        segments: list[EncodedSegment] = []
+        prof = self.stages
         F = ysd.shape[1]
         nmb = mbw * mbh
         L = (nmb * _INTRA_MB + (F - 1) * nmb * _P_FLAT_MB if self.inter
              else nmb * _INTRA_MB)
+        # Barrier on a tiny count output first: it completes when the
+        # wave's compute does, splitting "waiting on the device" from
+        # the bulk D2H fetch in the stage breakdown.
+        t0 = time.perf_counter()
+        _ = jax.device_get(out[2] if self.inter else out[0])
+        prof.add("device_wait", time.perf_counter() - t0)
+        flat = None
         if self.inter:
-            (mv8, dc16, nblk, nval, n_esc, bitmap, bmask16,
-             vals) = jax.device_get(out)
+            with prof.stage("fetch"):
+                (mv8, dc16, nblk, nval, n_esc, bitmap, bmask16,
+                 vals) = jax.device_get(out)
             # dense prefix = both intra hadamard DC segments (luma +
             # chroma); the sparse remainder skips them (_per_gop_sparse)
-            ndc, nlac, ncdc = nmb * 16, nmb * 240, nmb * 8
+            ndc, ncdc = nmb * 16, nmb * 8
             Lr = L - ndc - ncdc
             sparse_ok = jaxcore.block_sparse2_fits(
                 nblk.max(), nval.max(), n_esc.max(), Lr)
         else:
-            nnz, n_esc, bitmap, vals, esc_pos, esc_val = jax.device_get(out)
+            with prof.stage("fetch"):
+                nnz, n_esc, bitmap, vals, esc_pos, esc_val = \
+                    jax.device_get(out)
             sparse_ok = jaxcore.sparse_fits(nnz.max(), n_esc.max(), L)
         if not sparse_ok:
-            if self.inter and self.num_devices == 1:
-                flat = jax.device_get(_encode_gop_single_dense(
-                    ysd, usd, vsd, qpsd, mbw=mbw, mbh=mbh,
-                    dtype=jnp.int16))
-            elif self.inter:
-                flat = jax.device_get(_encode_wave_gop_dense(
-                    ysd, usd, vsd, qpsd, mbw=mbw, mbh=mbh,
-                    mesh=self.mesh, dtype=jnp.int16))
-            else:
-                flat = jax.device_get(_encode_wave_dense(
-                    ysd, usd, vsd, jnp.asarray(self.qp), mbw=mbw,
-                    mbh=mbh, mesh=self.mesh, dtype=jnp.int16))
+            with prof.stage("fetch"):
+                if self.inter and self.num_devices == 1:
+                    flat = jax.device_get(_encode_gop_single_dense(
+                        ysd, usd, vsd, qpsd, mbw=mbw, mbh=mbh,
+                        dtype=jnp.int16))
+                elif self.inter:
+                    flat = jax.device_get(_encode_wave_gop_dense(
+                        ysd, usd, vsd, qpsd, mbw=mbw, mbh=mbh,
+                        mesh=self.mesh, dtype=jnp.int16))
+                else:
+                    flat = jax.device_get(_encode_wave_dense(
+                        ysd, usd, vsd, qpsd, mbw=mbw, mbh=mbh,
+                        mesh=self.mesh, dtype=jnp.int16))
         # Header QP must match what the device QUANTIZED with — read it
         # from the staged per-wave array, not the live gop_qp dict (a
         # caller mutating gop_qp between passes must not desync slices
@@ -395,47 +583,76 @@ class GopShardEncoder:
                                 start_frame=(g.start_frame
                                              + self.frame_offset))
                     for g in wave]
+        # Phase 1: unpack levels and SUBMIT every GOP's slice thunks, so
+        # the pool packs the whole wave's slices concurrently; phase 2
+        # gathers in GOP order.
+        pool = self._slice_pool()
+        jobs: list[tuple] = []
         for gi, gop in enumerate(wave):
             gop_qp = int(qps_host[gi])
             if self.inter:
                 if sparse_ok:
-                    dense = np.asarray(dc16[gi])
-                    rest = jaxcore._block_sparse_unpack2(
-                        int(nblk[gi]), int(nval[gi]), bitmap[gi],
-                        bmask16[gi], vals[gi], Lr)
-                    # restore flat layout: luma DC | luma AC | chroma DC
-                    # | chroma AC + P planes
-                    raw = np.concatenate([dense[:ndc], rest[:nlac],
-                                          dense[ndc:], rest[nlac:]])
+                    with prof.stage("sparse_unpack"):
+                        rest = _sparse_unpack2_host(
+                            int(nblk[gi]), int(nval[gi]), bitmap[gi],
+                            bmask16[gi], vals[gi], Lr)
+                    with prof.stage("unflatten"):
+                        intra, planes = _unflatten_gop_parts(
+                            dc16[gi], rest, mv8[gi], F, mbw, mbh)
                 else:
-                    raw = flat[gi]
-                payload = self._pack_gop(gop, mv8[gi], raw, F, mbw, mbh,
-                                         qp=gop_qp)
+                    with prof.stage("unflatten"):
+                        intra, planes = _unflatten_gop(
+                            flat[gi], mv8[gi], F, mbw, mbh)
+                # gop.num_frames (not F) drops the wave's tail-repeat
+                # padding.
+                thunks = gop_slice_thunks_planes(
+                    intra, planes, gop.num_frames, mbw, mbh, self.sps,
+                    self.pps, gop_qp, idr_pic_id=gop.index)
             else:
-                payload = []
+                thunks = []
                 for fi in range(gop.num_frames):
                     if sparse_ok:
-                        raw = jaxcore._sparse_unpack(
-                            int(nnz[gi, fi]), int(n_esc[gi, fi]),
-                            bitmap[gi, fi], vals[gi, fi],
-                            esc_pos[gi, fi], esc_val[gi, fi], L)
+                        with prof.stage("sparse_unpack"):
+                            raw = jaxcore._sparse_unpack(
+                                int(nnz[gi, fi]), int(n_esc[gi, fi]),
+                                bitmap[gi, fi], vals[gi, fi],
+                                esc_pos[gi, fi], esc_val[gi, fi], L)
                     else:
                         raw = flat[gi, fi]
-                    levels = jaxcore._unpack_levels(raw, mbw, mbh)
-                    nal = pack_slice(
-                        levels, mbw, mbh, self.sps, self.pps,
-                        self.qp, idr=True,
-                        idr_pic_id=(gop.start_frame + fi) % 65536)
-                    if fi == 0:
-                        nal = self.sps.to_nal() + self.pps.to_nal() + nal
-                    payload.append(nal)
-            segments.append(EncodedSegment(
-                gop=gop, payload=b"".join(payload),
-                frame_sizes=tuple(len(p) for p in payload)))
+                    thunks.append(functools.partial(
+                        self._pack_intra_frame, raw, mbw, mbh, gop, fi,
+                        gop_qp))
+            if pool is None:
+                jobs.append((gop, thunks, None))
+            else:
+                jobs.append((gop, None, [pool.submit(t) for t in thunks]))
+        segments: list[EncodedSegment] = []
+        for gop, thunks, futs in jobs:
+            with prof.stage("pack"):
+                payload = ([t() for t in thunks] if futs is None
+                           else [f.result() for f in futs])
+            with prof.stage("concat"):
+                seg = EncodedSegment(
+                    gop=gop, payload=b"".join(payload),
+                    frame_sizes=tuple(len(p) for p in payload))
+            segments.append(seg)
+        prof.count_wave()
         return segments
 
-    #: in-flight wave window: staged inputs + outputs of this many waves
-    #: stay alive at once (device queue depth x transfer overlap).
+    def _pack_intra_frame(self, raw, mbw: int, mbh: int, gop: GopSpec,
+                          fi: int, qp: int) -> bytes:
+        """Pack one all-intra frame's IDR slice (+ SPS/PPS at the GOP
+        head) from its flat levels — the intra path's slice-pool unit."""
+        levels = jaxcore._unpack_levels(raw, mbw, mbh)
+        nal = pack_slice(levels, mbw, mbh, self.sps, self.pps, qp,
+                         idr=True,
+                         idr_pic_id=(gop.start_frame + fi) % 65536)
+        if fi == 0:
+            nal = self.sps.to_nal() + self.pps.to_nal() + nal
+        return nal
+
+    #: default in-flight wave window when neither the constructor nor
+    #: the `pipeline_window` setting (TVT_PIPELINE_WINDOW) override it.
     PIPELINE_WINDOW = 4
 
     def encode_waves(self, waves, window: int | None = None,
@@ -444,22 +661,27 @@ class GopShardEncoder:
         """Dispatch staged waves: device compute → async sparse fetch →
         host entropy pack, in wave order.
 
-        Pipelined three ways: up to `window` waves are dispatched ahead
-        (device queue + async device→host copies overlap the current
-        fetch), and each wave's fetch+pack runs on a thread pool (the
-        ctypes CAVLC packer releases the GIL, GOPs are independent), so
-        host packing overlaps device compute of later waves.
+        Pipelined three ways: up to `window` (default: the
+        `pipeline_window` setting) waves are dispatched ahead — device
+        queue + async device→host copies overlap the current fetch —
+        each wave's fetch+unpack runs on a collector thread per
+        in-flight wave, and every slice of every in-flight GOP packs
+        on this encoder's `pack_workers` pool (collect_wave), so host
+        packing scales with cores instead of with the window.
         """
         import concurrent.futures as cf
-        import os as _os
 
-        window = window or self.PIPELINE_WINDOW
-        workers = pack_workers or min(window, _os.cpu_count() or 2)
+        window = window or self.pipeline_window
+        if pack_workers is not None and int(pack_workers) != self.pack_workers:
+            self.pack_workers = int(pack_workers)
+            if self._pack_pool is not None:   # resize: retire the old pool
+                self._pack_pool.shutdown(wait=False)
+            self._pack_pool = self._new_pack_pool()
         segments: list[EncodedSegment] = []
         waves = iter(waves)
         pending: list[cf.Future] = []
 
-        with cf.ThreadPoolExecutor(workers) as pool:
+        with cf.ThreadPoolExecutor(window) as pool:
             def dispatch_next():
                 try:
                     staged = next(waves)
@@ -478,21 +700,6 @@ class GopShardEncoder:
                 dispatch_next()
                 segments.extend(segs)
         return segments
-
-    def _pack_gop(self, gop: GopSpec, mv8: np.ndarray, flat: np.ndarray,
-                  F: int, mbw: int, mbh: int,
-                  qp: int | None = None) -> list[bytes]:
-        """Entropy-pack one GOP (IDR + P slices) from its flat levels.
-        `qp` must match the QP the device quantized this GOP with (the
-        slice headers carry its delta vs PPS init_qp)."""
-        from ..codecs.h264.encoder import pack_gop_slices_planes
-
-        intra, planes = _unflatten_gop(flat, mv8, F, mbw, mbh)
-        # gop.num_frames (not F) drops the wave's tail-repeat padding.
-        return pack_gop_slices_planes(intra, planes, gop.num_frames,
-                                      mbw, mbh, self.sps, self.pps,
-                                      self.qp if qp is None else qp,
-                                      idr_pic_id=gop.index)
 
     @staticmethod
     def _gop_plane(padded: list[Frame], gop: GopSpec, F: int, plane: str
