@@ -15,9 +15,15 @@ Extra keys: `device_gop_fps` times the SAME GOP program device-side only
 (comparable to `value`, unlike the old intra-only figure), `fps_2160p`
 is the 4K end-to-end line (BASELINE config 3's resolution).
 
-Source frames are pre-staged in HBM before the timed region (the design
-invariant: kernels run over HBM-resident YUV planes; ingest/upload is a
-separate, overlappable pipeline stage).
+For `value`, source frames are pre-staged in HBM before the timed
+region (the design invariant: kernels run over HBM-resident YUV
+planes). `fps_cold_1080p` drops that flattering boundary: the same clip
+runs COLD through the production streaming path — y4m on disk →
+range-seek decode → background staging thread (decode + stack + H2D,
+`decode_ahead` waves ahead) → wave dispatch → pack → concat — so the
+overlap of ingest with device compute is measured, not assumed. Its
+per-stage breakdown (including the new `decode`/`stage` keys) rides as
+`stage_ms_cold`.
 
 Compile time is excluded (one warmup wave per resolution).
 """
@@ -136,12 +142,55 @@ def _run_pipeline(w: int, h: int, nframes: int, qp: int, gop_frames: int,
     }
 
 
+def _run_cold(w: int, h: int, nframes: int, qp: int, gop_frames: int,
+              runs: int = 3) -> dict:
+    """Cold end-to-end fps: decode → stage (H2D) → encode → concat
+    through the production streaming ingest (ingest.open_video +
+    GopShardEncoder.encode's background staging thread), nothing
+    pre-staged in HBM. Source decode and upload overlap device compute,
+    so this should track the HBM-resident figure closely — the gap IS
+    the ingest pipeline's cost."""
+    import os
+    import tempfile
+
+    from thinvids_tpu.core.types import VideoMeta, concat_segments
+    from thinvids_tpu.ingest.decode import open_video
+    from thinvids_tpu.io.y4m import write_y4m
+    from thinvids_tpu.parallel.dispatch import GopShardEncoder
+
+    meta = VideoMeta(width=w, height=h, fps_num=30, fps_den=1,
+                     num_frames=nframes)
+    fd, path = tempfile.mkstemp(suffix=".y4m")
+    os.close(fd)
+    try:
+        write_y4m(path, meta, make_frames(nframes, w, h))
+        enc = GopShardEncoder(meta, qp=qp, gop_frames=gop_frames)
+        src = open_video(path)
+        # warmup: compile every wave shape + build the native packer
+        # through the very path being timed
+        concat_segments(enc.encode(src))
+        t_cold = float("inf")
+        stage_ms: dict = {}
+        for _ in range(runs):
+            enc.stages.reset()
+            t0 = time.perf_counter()
+            stream = concat_segments(enc.encode(src))
+            t = time.perf_counter() - t0
+            if t < t_cold:
+                t_cold, stage_ms = t, enc.stages.snapshot()
+        return {"fps": nframes / t_cold, "bytes": len(stream),
+                "stage_ms": stage_ms}
+    finally:
+        os.unlink(path)
+
+
 def build_result(r1080: dict, r4k: dict, *, platform: str, qp: int,
-                 gop: int, n_1080: int) -> dict:
+                 gop: int, n_1080: int, cold: dict | None = None) -> dict:
     """Assemble the one-line BENCH JSON from the two resolutions' runs
     (kept separate from main() so tests can assert the schema — e.g.
-    the `stage_ms` breakdown — on a small CPU run)."""
-    return {
+    the `stage_ms` breakdown and the `fps_cold_1080p` cold figure — on
+    a small CPU run)."""
+    out = {
         "metric": "h264_gop_1080p_fps",
         "value": round(r1080["fps"], 2),
         "unit": "fps",
@@ -158,6 +207,10 @@ def build_result(r1080: dict, r4k: dict, *, platform: str, qp: int,
         **r1080["quality"],
         **{f"{k}_2160p": v for k, v in r4k["quality"].items()},
     }
+    if cold is not None:
+        out["fps_cold_1080p"] = round(cold["fps"], 2)
+        out["stage_ms_cold"] = cold["stage_ms"]
+    return out
 
 
 def main() -> None:
@@ -171,13 +224,18 @@ def main() -> None:
     n_1080 = 64
     r1080 = _run_pipeline(1920, 1080, n_1080, qp, gop)
 
+    # Cold figure: the same clip through the production streaming
+    # ingest (decode from disk overlapped with device compute) — the
+    # wave-shape compiles are already warm from the resident run.
+    r_cold = _run_cold(1920, 1080, n_1080, qp, gop)
+
     # 4K rides with quality ON (psnr_y_2160p/ssim_y_2160p): 16 frames
     # keeps the untimed oracle decode affordable.
     n_4k = 16
     r4k = _run_pipeline(3840, 2160, n_4k, qp, gop, quality=True)
 
     print(json.dumps(build_result(r1080, r4k, platform=platform, qp=qp,
-                                  gop=gop, n_1080=n_1080)))
+                                  gop=gop, n_1080=n_1080, cold=r_cold)))
 
 
 if __name__ == "__main__":

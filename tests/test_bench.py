@@ -1,9 +1,11 @@
 """BENCH JSON schema guards.
 
 The round driver parses bench.py's single JSON line; these tests pin the
-schema — in particular the `stage_ms` host-stage breakdown and the 4K
-quality key naming — on a small CPU run (tiny resolution, no oracle
-decode) so a schema regression fails fast instead of at round scoring.
+schema — in particular the `stage_ms` host-stage breakdown (now
+including the streaming-ingest `decode`/`stage` keys), the cold
+end-to-end `fps_cold_1080p` figure, and the 4K quality key naming — on
+a small CPU run (tiny resolution, no oracle decode) so a schema
+regression fails fast instead of at round scoring.
 """
 
 import bench
@@ -20,6 +22,15 @@ def test_run_pipeline_reports_stage_breakdown():
     assert r["stage_ms"]["waves"] >= 1
 
 
+def test_run_cold_reports_streaming_breakdown():
+    """The cold figure runs the production streaming ingest; its stage
+    breakdown must carry the decode/stage keys that path exercises."""
+    r = bench._run_cold(64, 48, nframes=4, qp=27, gop_frames=2, runs=1)
+    assert r["fps"] > 0 and r["bytes"] > 0
+    assert "decode" in r["stage_ms"] and "stage" in r["stage_ms"]
+    assert r["stage_ms"]["waves"] >= 1
+
+
 def test_bench_result_schema_includes_stage_ms():
     from thinvids_tpu.parallel.dispatch import STAGE_NAMES
 
@@ -28,11 +39,20 @@ def test_bench_result_schema_includes_stage_ms():
          "quality": {"psnr_y": 40.1, "ssim_y": 0.99}}
     r4k = {"fps": 2.8, "device_fps": 7.0, "bytes": 9000,
            "stage_ms": {}, "quality": {"psnr_y": 41.0, "ssim_y": 0.98}}
+    cold = {"fps": 31.1, "bytes": 1200,
+            "stage_ms": {k: 1.0 for k in STAGE_NAMES} | {"waves": 2}}
     result = bench.build_result(r, r4k, platform="cpu", qp=27, gop=8,
-                                n_1080=64)
+                                n_1080=64, cold=cold)
     assert result["value"] == 33.3
     assert result["fps_2160p"] == 2.8
     assert set(STAGE_NAMES) <= set(result["stage_ms"])
+    # streaming-ingest stages are first-class schema keys
+    assert "decode" in result["stage_ms"] and "stage" in result["stage_ms"]
+    # cold end-to-end figure (decode -> encode -> concat, nothing
+    # pre-staged) + its own breakdown
+    assert result["fps_cold_1080p"] == 31.1
+    assert "decode" in result["stage_ms_cold"]
+    assert "stage" in result["stage_ms_cold"]
     # 4K quality rides with suffixed keys (VERDICT Weak #9)
     assert result["psnr_y_2160p"] == 41.0
     assert result["ssim_y_2160p"] == 0.98

@@ -512,6 +512,77 @@ class TestRemoteExecutorInProcess:
         assert any("coordinator mesh" in e["message"]
                    for e in coord.activity.fetch(200))
 
+    def test_direct_mode_job_encodes_on_coordinator_mesh(self, tmp_path):
+        """The admission policy's processing_mode finally has teeth:
+        a direct-mode job (here: oversize under
+        large_file_behavior="direct") encodes whole on the coordinator
+        mesh — it completes with NO worker ever claiming."""
+        import os
+
+        clip = tmp_path / "big.y4m"
+        write_clip(clip, n=8)
+        meta = VideoMeta(width=64, height=48, fps_num=30, fps_den=1,
+                         num_frames=8,
+                         size_bytes=os.path.getsize(str(clip)))
+        snap = make_settings(gop_frames=2, qp=30, heartbeat_throttle_s=0.0,
+                             large_file_gb=1e-9,
+                             large_file_behavior="direct",
+                             min_idle_workers=0)
+        coord, execu = make_remote_rig(tmp_path, snap)   # nobody claims
+        job = coord.add_job(str(clip), meta)
+        job = coord.store.get(job.id)
+        assert job.processing_mode == "direct"
+        assert job.status is Status.DONE, job.failure_reason
+        assert any("direct mode" in e["message"]
+                   for e in coord.activity.fetch(200))
+        # nothing ever hit the farm board
+        assert execu.board.snapshot()["shards"]["done"] == 0
+
+    def test_recovered_job_defers_planning_until_workers_heartbeat(
+            self, tmp_path):
+        """The coordinator-restart scenario (ROADMAP open item): the
+        job launches while only non-claim-capable agents are registered
+        (the coordinator's own device pseudo-hosts). Shard planning
+        must wait for the first worker heartbeats instead of
+        degenerating to 2 giant shards against an empty farm."""
+        clip = tmp_path / "clip.y4m"
+        meta = write_clip(clip, n=16)
+        snap = make_settings(gop_frames=2, qp=30, heartbeat_throttle_s=0.0,
+                             remote_plan_devices=8,
+                             remote_no_worker_grace_s=10.0,
+                             min_idle_workers=0)
+        coord, execu = make_remote_rig(tmp_path, snap, workers=0)
+        # metrics-only agents satisfy admission but can't take shards
+        for i in range(8):
+            coord.registry.heartbeat(f"dev{i}")
+        stop = threading.Event()
+
+        def late_farm():
+            time.sleep(0.15)
+            for i in range(4):
+                coord.registry.heartbeat(f"w{i:02d}",
+                                         metrics={"worker": True})
+                time.sleep(0.3)     # STAGGERED re-heartbeats, like a
+                                    # real farm restart — the settle
+                                    # window must count the farm whole,
+                                    # not plan on worker #1 alone
+            for i in range(2):
+                board_worker(execu.board, f"w{i:02d}", stop)
+
+        threading.Thread(target=late_farm, daemon=True).start()
+        try:
+            job = coord.add_job(str(clip), meta)
+        finally:
+            stop.set()
+        job = coord.store.get(job.id)
+        assert job.status is Status.DONE, job.failure_reason
+        events = [e["message"] for e in coord.activity.fetch(400)]
+        # 8 GOPs over the 4 late workers -> auto ~2 shards/worker ->
+        # 8 single-GOP shards; the empty-registry degenerate plan
+        # would have been "as 2 shards"
+        assert any("as 8 shards" in m for m in events), events
+        assert job.parts_total == 8
+
 
 # ---------------------------------------------------------------------------
 # HTTP layer

@@ -1,0 +1,254 @@
+"""Streaming ingest pipeline tests (the ingest→stage→device tentpole):
+
+- FrameSource range access: y4m O(1) frame-range seek, mp4 GOP-range
+  decode, lazy slicing windows — all with the frames-decoded counter
+  proving the work is O(range), not O(clip).
+- Streamed-vs-materialized parity: the production streaming path
+  (open_video + background staging) emits a bitstream byte-identical
+  to the materialized list path, for y4m and mp4 inputs.
+- Bounded residency: a multi-wave encode never holds more than one
+  wave of decoded frames in the staging cursor.
+- Remote shard-range: a worker daemon's claim decodes only its
+  shard's [f0, f0+n) frame range.
+- Guard: the executors and the worker daemon must never regress to
+  the list-materializing read_video prologue.
+"""
+
+import inspect
+
+import numpy as np
+import pytest
+
+from thinvids_tpu.core.types import Frame, GopSpec, VideoMeta, concat_segments
+from thinvids_tpu.ingest.decode import open_video, read_video
+from thinvids_tpu.io.y4m import write_y4m
+from thinvids_tpu.tools import oracle
+
+
+def grad_frames(n, w=64, h=48):
+    yy, xx = np.mgrid[0:h, 0:w]
+    return [Frame(
+        y=((xx * 2 + yy + 7 * i) % 256).astype(np.uint8),
+        u=np.full((h // 2, w // 2), 108, np.uint8),
+        v=np.full((h // 2, w // 2), 148, np.uint8),
+    ) for i in range(n)]
+
+
+def write_clip(path, n=32, w=64, h=48):
+    meta = VideoMeta(width=w, height=h, fps_num=30, fps_den=1, num_frames=n)
+    write_y4m(str(path), meta, grad_frames(n, w, h))
+    return meta
+
+
+def assert_frames_equal(got, want):
+    assert len(got) == len(want)
+    for a, b in zip(got, want):
+        assert np.array_equal(a.y, b.y)
+        assert np.array_equal(a.u, b.u)
+        assert np.array_equal(a.v, b.v)
+
+
+def make_mp4(tmp_path, n=12, gop=4):
+    """Encode a tiny clip with our own encoder and mux it — the same
+    mp4-in fixture recipe test_transcode uses."""
+    from thinvids_tpu.io.mp4 import mux_mp4
+    from thinvids_tpu.parallel.dispatch import encode_clip_sharded
+
+    meta = VideoMeta(width=64, height=48, fps_num=30, fps_den=1,
+                     num_frames=n)
+    stream = encode_clip_sharded(grad_frames(n), meta, qp=27,
+                                 gop_frames=gop)
+    p = tmp_path / "in.mp4"
+    p.write_bytes(mux_mp4(stream, meta))
+    return p
+
+
+class TestY4MRangeAccess:
+    def test_open_video_meta_matches_materialized(self, tmp_path):
+        clip = tmp_path / "clip.y4m"
+        write_clip(clip, n=32)
+        src = open_video(str(clip))
+        meta, frames, audio = read_video(str(clip))
+        assert len(src) == 32
+        assert src.meta == meta
+        assert src.audio is None and audio is None
+        assert len(frames) == 32
+
+    def test_read_range_is_o_range_and_bit_exact(self, tmp_path):
+        clip = tmp_path / "clip.y4m"
+        write_clip(clip, n=32)
+        _meta, frames, _ = read_video(str(clip))
+        src = open_video(str(clip))
+        got = src.read_range(8, 8)
+        assert_frames_equal(got, frames[8:16])
+        # O(range): only the requested 8 frames were decoded — the
+        # fixed-size record arithmetic seeks straight to frame 8
+        assert src.frames_decoded == 8
+        assert [f.pts for f in got] == list(range(8, 16))
+
+    def test_lazy_window_slicing(self, tmp_path):
+        clip = tmp_path / "clip.y4m"
+        write_clip(clip, n=32)
+        _meta, frames, _ = read_video(str(clip))
+        src = open_video(str(clip))
+        window = src[8:16]
+        assert len(window) == 8
+        assert_frames_equal(list(window), frames[8:16])
+        nested = window[2:4]            # re-slicing composes offsets
+        assert_frames_equal(list(nested), frames[10:12])
+        assert np.array_equal(window[3].y, frames[11].y)
+        assert np.array_equal(src[-1].y, frames[31].y)
+        with pytest.raises(ValueError):
+            src[::2]
+
+    def test_restartable_iteration(self, tmp_path):
+        """Each iteration opens its own cursor (multi-pass encodes —
+        vbr2pass — re-read the source without interference)."""
+        clip = tmp_path / "clip.y4m"
+        write_clip(clip, n=8)
+        src = open_video(str(clip))
+        a = [f.y.copy() for f in src.iter_frames()]
+        b = [f.y.copy() for f in src.iter_frames()]
+        assert len(a) == len(b) == 8
+        assert all(np.array_equal(x, y) for x, y in zip(a, b))
+
+
+@pytest.mark.skipif(not oracle.oracle_available(),
+                    reason="libavcodec missing")
+class TestMp4RangeAccess:
+    def test_range_decode_is_gop_bounded_and_bit_exact(self, tmp_path):
+        p = make_mp4(tmp_path, n=12, gop=4)
+        _meta, frames, _ = read_video(str(p))
+        src = open_video(str(p))
+        got = src.read_range(5, 4)      # straddles the GOP-2 boundary
+        assert_frames_equal(got, frames[5:9])
+        # decode restarts at the sync sample before frame 5 (frame 4)
+        # and covers two closed GOPs — bounded by range + lead-in,
+        # never the whole clip
+        assert src.frames_decoded <= 8 < len(src)
+
+    def test_streamed_encode_bit_identical_mp4(self, tmp_path):
+        from thinvids_tpu.parallel.dispatch import GopShardEncoder
+
+        p = make_mp4(tmp_path, n=12, gop=4)
+        src = open_video(str(p))
+        _meta, frames, _ = read_video(str(p))
+        enc_a = GopShardEncoder(src.meta, qp=30, gop_frames=4)
+        want = concat_segments(enc_a.encode_waves(enc_a.stage_waves(frames)))
+        enc_b = GopShardEncoder(src.meta, qp=30, gop_frames=4)
+        got = concat_segments(enc_b.encode(src))
+        assert got == want
+
+
+class TestStreamedEncodeParity:
+    def test_streamed_encode_bit_identical_y4m(self, tmp_path):
+        """The full streaming path (open_video → background staging →
+        wave dispatch) vs the materialized list path: byte-identical
+        Annex-B out, byte-identical muxed MP4."""
+        from thinvids_tpu.io.mp4 import mux_mp4
+        from thinvids_tpu.parallel.dispatch import GopShardEncoder
+
+        clip = tmp_path / "clip.y4m"
+        write_clip(clip, n=32)
+        src = open_video(str(clip))
+        _meta, frames, _ = read_video(str(clip))
+        enc_a = GopShardEncoder(src.meta, qp=30, gop_frames=4)
+        want = concat_segments(enc_a.encode_waves(enc_a.stage_waves(frames)))
+        enc_b = GopShardEncoder(src.meta, qp=30, gop_frames=4)
+        got = concat_segments(enc_b.encode(src))
+        assert got == want
+        assert mux_mp4(got, src.meta) == mux_mp4(want, src.meta)
+
+    def test_staging_error_propagates_from_background_thread(self):
+        """A decode failure on the staging thread re-raises at the
+        consumer — never a silent hang or truncated output."""
+        from thinvids_tpu.parallel.dispatch import background_stage
+
+        def boom():
+            yield "first"
+            raise RuntimeError("decode exploded")
+
+        feed = background_stage(boom(), decode_ahead=2)
+        assert next(feed) == "first"
+        with pytest.raises(RuntimeError, match="decode exploded"):
+            list(feed)
+
+
+class TestBoundedResidency:
+    def test_peak_resident_frames_is_one_wave(self, tmp_path):
+        """A long multi-wave encode holds at most one wave of decoded
+        frames in the staging cursor (plus `decode_ahead` staged waves
+        as device arrays and `pipeline_window` in flight — none of
+        which retain host Frames), and every frame decodes exactly
+        once."""
+        import jax
+
+        from thinvids_tpu.parallel.dispatch import (GopShardEncoder,
+                                                    default_mesh)
+
+        clip = tmp_path / "long.y4m"
+        write_clip(clip, n=32)
+        src = open_video(str(clip))
+        # 1 device x 1 gop/wave x gop 4 -> 8 waves of 4 frames
+        enc = GopShardEncoder(src.meta, qp=30,
+                              mesh=default_mesh(jax.devices()[:1]),
+                              gop_frames=4, gops_per_wave=1,
+                              decode_ahead=2, pipeline_window=2)
+        segments = enc.encode(src)
+        assert len(segments) == 8
+        assert src.frames_decoded == 32             # decoded once each
+        wave_frames = 4
+        assert 0 < enc.staging_stats["peak_resident_frames"] \
+            <= wave_frames + 1
+        # and the streamed result is still the correct bitstream
+        _meta, frames, _ = read_video(str(clip))
+        ref = GopShardEncoder(src.meta, qp=30,
+                              mesh=default_mesh(jax.devices()[:1]),
+                              gop_frames=4, gops_per_wave=1)
+        assert concat_segments(segments) == concat_segments(
+            ref.encode_waves(ref.stage_waves(frames)))
+
+
+class TestRemoteShardRange:
+    def test_worker_decodes_only_its_shard_range(self, tmp_path):
+        """A worker daemon's claim decodes exactly the shard's
+        [f0, f0+n) frames — O(shard), not O(clip) — and the part is
+        identical to one cut from a whole-clip decode."""
+        from thinvids_tpu.cluster.remote import (Shard, WorkerDaemon,
+                                                 encode_shard)
+
+        clip = tmp_path / "clip.y4m"
+        meta = write_clip(clip, n=32)
+        gops = tuple(GopSpec(index=i, start_frame=4 * i, num_frames=4)
+                     for i in (2, 3))
+        desc = Shard(id="s0", job_id="j0", input_path=str(clip),
+                     meta=meta, gops=gops, qp=30, gop_frames=4,
+                     timeout_s=60.0).descriptor()
+
+        daemon = WorkerDaemon("http://127.0.0.1:1")
+        source = daemon._frames(str(clip))
+        segments = encode_shard(desc, source)
+        assert source.frames_decoded == 8           # frames [8, 16) only
+        assert [s.gop.start_frame for s in segments] == [8, 12]
+        # identical to the same descriptor over a materialized clip
+        _meta, frames, _ = read_video(str(clip))
+        want = encode_shard(desc, frames)
+        assert [s.payload for s in segments] == [s.payload for s in want]
+        # the cache keeps the OPENED source (no decoded frames)
+        assert daemon._frames(str(clip)) is source
+
+
+class TestNoMaterializedPrologue:
+    def test_executor_paths_never_import_read_video(self):
+        """CI guard: the blocking decode prologue must not come back —
+        the executors and the worker daemon stream via open_video;
+        read_video (list-materializing) is reserved for small-clip
+        tools (stamping, import, tests)."""
+        import thinvids_tpu.cluster.executor as executor_mod
+        import thinvids_tpu.cluster.remote as remote_mod
+
+        for mod in (executor_mod, remote_mod):
+            src = inspect.getsource(mod)
+            assert "read_video" not in src, (
+                f"{mod.__name__} must stream via open_video, not "
+                f"materialize via read_video")

@@ -481,18 +481,32 @@ class ApiServer:
                                                   Status.STAMPING))
 
         def work() -> None:
-            from ..ingest.decode import read_video
+            from ..ingest.decode import open_video
             from ..ingest.probe import probe_video
-            from ..io.y4m import write_y4m
+            from ..io.y4m import Y4MWriter
             from ..tools.stamp import stamp_frame
 
             try:
-                meta, frames, _audio = read_video(job.input_path)
-                stamped = [stamp_frame(f, i)
-                           for i, f in enumerate(frames)]
                 base, _ext = os.path.splitext(job.input_path)
                 out = base + ".stamped.y4m"
-                write_y4m(out, meta, stamped)
+                # streaming: decode → stamp → write one frame at a
+                # time, so stamping a long clip never materializes it
+                # in coordinator RAM (same ingest path the executors
+                # stream through). Stream into a temp path and commit
+                # atomically: a mid-stream decode error must not leave
+                # a truncated .stamped.y4m behind (or clobber a good
+                # one from an earlier POST).
+                tmp = f"{out}.{job.id}.tmp"
+                try:
+                    with open_video(job.input_path) as src, \
+                            open(tmp, "wb") as fp:
+                        writer = Y4MWriter(fp, src.meta)
+                        for i, frame in enumerate(src.iter_frames()):
+                            writer.write(stamp_frame(frame, i))
+                    os.replace(tmp, out)
+                finally:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
                 # Dedup on the target path: a repeated POST /stamp_job
                 # refreshes the stamped file but must not register the
                 # same .stamped.y4m as a second job.
@@ -524,8 +538,9 @@ class ApiServer:
         metrics = {w.host: dict(w.metrics, last_seen=w.last_seen)
                    for w in self.coordinator.registry.all()}
         out: dict[str, Any] = {"metrics": metrics}
-        # Host encode-stage breakdown (dispatch / device wait / fetch /
-        # sparse unpack / unflatten / pack / concat wall-clock ms) for
+        # Host encode-stage breakdown (decode / stage / dispatch /
+        # device wait / fetch / sparse unpack / unflatten / pack /
+        # concat wall-clock ms) for
         # every live encoder in this process. Read through sys.modules:
         # if no encoder ever ran here (e.g. a pure-manager node), don't
         # drag jax in just to report an empty dict.

@@ -54,13 +54,6 @@ def run_coordinator(args: argparse.Namespace) -> None:
         execu = LocalExecutor(co, args.output_dir, sync=False)
         work = None
     co._launcher = execu.launch
-    requeued = co.recover_jobs()
-    if requeued:
-        log.info("requeued %d orphaned jobs after restart", len(requeued))
-    # scheduler poll + watchdog (the reference's daemon threads,
-    # app.py:1474-1516) — without these a WAITING job whose dispatch
-    # gate failed once would sit queued forever
-    co.start_background()
 
     roots = {name: path for name, path in
              (("watch", args.watch_dir), ("library", args.output_dir))
@@ -68,6 +61,20 @@ def run_coordinator(args: argparse.Namespace) -> None:
     api = ApiServer(co, host=args.host, port=args.port,
                     browse_roots=roots, work=work).start()
     log.info("api + dashboard on %s", api.url)
+
+    # Recover orphans AFTER the API is up: recovered remote jobs plan
+    # their shards against the live-worker registry, so workers must be
+    # able to re-heartbeat first (the remote executor additionally
+    # waits for the first heartbeat before planning — cluster/remote.py
+    # _await_first_workers; previously recovery ran before the API and
+    # a full farm restarted onto 2 giant shards).
+    requeued = co.recover_jobs()
+    if requeued:
+        log.info("requeued %d orphaned jobs after restart", len(requeued))
+    # scheduler poll + watchdog (the reference's daemon threads,
+    # app.py:1474-1516) — without these a WAITING job whose dispatch
+    # gate failed once would sit queued forever
+    co.start_background()
 
     # Local agent: the coordinator host reports its own health, and its
     # accelerator devices register as encode slots — on a TPU host the

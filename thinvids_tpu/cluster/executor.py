@@ -24,7 +24,7 @@ from collections import deque
 from typing import Callable
 
 from ..core.status import Status
-from ..ingest.decode import read_video
+from ..ingest.decode import open_video
 from ..io.mp4 import mux_mp4
 from ..core.types import concat_segments
 from .coordinator import Coordinator
@@ -96,17 +96,23 @@ class LocalExecutor:
         # one-element list: the encode hook advances the stage marker in
         # place so failure attribution survives the subclass seam
         stage = ["probe"]
+        source = None
         try:
             settings = co.job_settings(job)
             co.heartbeat_job(job.id, token, stage[0], host=self.host)
-            meta, frames, audio = read_video(job.input_path)
-            if not frames:
+            # streaming ingest: open (header parse / container demux)
+            # WITHOUT decoding — frames decode wave-by-wave during the
+            # encode, so the clip never materializes in host RAM and
+            # time-to-first-wave is one wave's decode
+            source = open_video(job.input_path)
+            meta, audio = source.meta, source.audio
+            if not len(source):
                 raise ValueError(f"no frames in {job.input_path}")
             if not co.mark_running(job.id, token):
                 raise HaltedError("fenced before start")
 
             with self._maybe_trace(settings, job):
-                segments = self._encode_job(job, token, frames, settings,
+                segments = self._encode_job(job, token, source, settings,
                                             meta, stage)
 
             stage[0] = "stitch"
@@ -128,14 +134,19 @@ class LocalExecutor:
         except Exception as exc:            # noqa: BLE001 - attribute & fail
             co.fail_job(job.id, token, stage=stage[0], host=self.host,
                         reason=f"{type(exc).__name__}: {exc}")
+        finally:
+            if source is not None:
+                source.close()
 
     def _encode_job(self, job: Job, token: str, frames, settings, meta,
                     stage: list) -> list:
         """segment + encode stages → ordered EncodedSegments. The seam
         the remote backend overrides (cluster/remote.py dispatches GOP
         shards to worker daemons here); this implementation runs on the
-        local process's device mesh. `stage` is a one-element list the
-        hook mutates for failure attribution."""
+        local process's device mesh. `frames` is a lazy FrameSource
+        (len + slicing + iteration; ingest/decode.py) — treat it as a
+        sequence, never materialize it wholesale. `stage` is a
+        one-element list the hook mutates for failure attribution."""
         co = self.coordinator
         stage[0] = "segment"
         enc = self._encoder_factory(meta, settings, self.mesh)
@@ -287,12 +298,21 @@ class LocalExecutor:
                       done0: int) -> list:
         """Depth-2 pipelined wave loop over frames[start_frame:].
 
-        Staging stays lazy (stage_waves's bounded-HBM invariant): only
-        the <=2 in-flight waves keep their staged device arrays alive,
-        and a retried wave re-dispatches from its retained staged tuple.
+        The decode → stack → H2D staging chain runs on a background
+        staging thread (`decode_ahead` waves ahead of the dispatch
+        window — parallel/dispatch.background_stage), so ingest
+        overlaps device compute instead of serializing ahead of it.
+        Staging stays bounded, not free: input residency is now the 2
+        in-flight waves PLUS up to `decode_ahead` staged-but-undispatched
+        waves (+1 blocked in the queue put) of HBM-resident YUV arrays —
+        size `decode_ahead` against the device's HBM headroom, not just
+        source latency. A retried wave re-dispatches from its retained
+        staged tuple.
         Raises _WaveExhausted (carrying the range's completed segments)
         when one wave fails `part_failure_max_retries` times.
         """
+        from ..parallel.dispatch import GopShardEncoder, background_stage
+
         co = self.coordinator
         max_retries = int(settings.part_failure_max_retries)
         if start_frame:
@@ -301,7 +321,17 @@ class LocalExecutor:
             # globally consistent with already-completed ones
             enc.gop_index_offset = done0
             enc.frame_offset = start_frame
-        staged_iter = enumerate(enc.stage_waves(frames[start_frame:]))
+        # the encoder already resolved the `decode_ahead` setting in
+        # its constructor (like pack_workers/pipeline_window), so honor
+        # its knob — incl. explicit constructor overrides; the class
+        # default only covers test doubles that lack the attribute
+        decode_ahead = int(getattr(enc, "decode_ahead", 0) or 0) \
+            or GopShardEncoder.DECODE_AHEAD
+        feed = background_stage(
+            enc.stage_waves(frames[start_frame:] if start_frame
+                            else frames),
+            decode_ahead)
+        staged_iter = enumerate(feed)
         segments: list = []
         done = done0
         pending: deque = deque()        # (idx, staged, handle)
@@ -318,36 +348,43 @@ class LocalExecutor:
                 return
             pending.append((i, staged, enc.dispatch_wave(staged)))
 
-        dispatch_next()
-        while pending:
-            halt_check()
-            if len(pending) < 2:
-                dispatch_next()         # overlap: depth-2 window, no more
-            i, staged, handle = pending.popleft()
-            try:
-                segs = enc.collect_wave(handle)
-            except HaltedError:
-                raise
-            except Exception as exc:    # noqa: BLE001 - wave retry budget
-                n = attempts.get(i, 0) + 1
-                attempts[i] = n
-                if n > max_retries:
-                    raise _WaveExhausted(
-                        f"wave {i} failed after {n - 1} retries: "
-                        f"{type(exc).__name__}: {exc}", segments) from exc
-                co.activity.emit(
-                    "encode", f"wave {i} attempt {n} failed, retrying: "
-                    f"{exc}", job_id=job.id, host=self.host)
-                retried = co.store.get(job.id).parts_retried + len(staged[0])
-                co.update_progress(job.id, token, parts_retried=retried)
+        try:
+            dispatch_next()
+            while pending:
                 halt_check()
-                pending.appendleft((i, staged, enc.dispatch_wave(staged)))
-                continue
-            segments.extend(segs)
-            done += len(segs)
-            co.update_progress(
-                job.id, token, parts_done=done,
-                encode_progress=100.0 * done / max(1, total_gops))
-            co.heartbeat_job(job.id, token, "encode", host=self.host,
-                             note=f"{done}/{total_gops} GOPs")
-        return segments
+                if len(pending) < 2:
+                    dispatch_next()     # overlap: depth-2 window, no more
+                i, staged, handle = pending.popleft()
+                try:
+                    segs = enc.collect_wave(handle)
+                except HaltedError:
+                    raise
+                except Exception as exc:  # noqa: BLE001 - wave retry budget
+                    n = attempts.get(i, 0) + 1
+                    attempts[i] = n
+                    if n > max_retries:
+                        raise _WaveExhausted(
+                            f"wave {i} failed after {n - 1} retries: "
+                            f"{type(exc).__name__}: {exc}", segments) \
+                            from exc
+                    co.activity.emit(
+                        "encode", f"wave {i} attempt {n} failed, "
+                        f"retrying: {exc}", job_id=job.id, host=self.host)
+                    retried = co.store.get(job.id).parts_retried \
+                        + len(staged[0])
+                    co.update_progress(job.id, token, parts_retried=retried)
+                    halt_check()
+                    pending.appendleft((i, staged,
+                                        enc.dispatch_wave(staged)))
+                    continue
+                segments.extend(segs)
+                done += len(segs)
+                co.update_progress(
+                    job.id, token, parts_done=done,
+                    encode_progress=100.0 * done / max(1, total_gops))
+                co.heartbeat_job(job.id, token, "encode", host=self.host,
+                                 note=f"{done}/{total_gops} GOPs")
+            return segments
+        finally:
+            feed.close()                # stop the staging thread
+                                        # (halt / replan / exhaustion)

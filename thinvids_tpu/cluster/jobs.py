@@ -38,7 +38,9 @@ class Job:
     status: Status = Status.READY
     # settings overlay (core.config.JOB_SETTING_KEYS subset)
     settings: dict[str, Any] = dataclasses.field(default_factory=dict)
-    # admission decision
+    # admission decision (policy.py): the remote backend encodes
+    # "direct" jobs whole on the coordinator mesh instead of farming
+    # split shards (cluster/remote.py)
     processing_mode: str = "split"       # split | direct
     reject_reason: str = ""
     # scheduling / fencing

@@ -497,12 +497,15 @@ class RemoteExecutor(LocalExecutor):
     daemons instead of the local mesh. Shares LocalExecutor's whole
     probe → stitch → mux → complete scaffolding; only the encode stage
     (`_encode_job`) differs. vbr2pass jobs still encode locally — the
-    two-pass QP solver needs global complexity stats on one mesh.
+    two-pass QP solver needs global complexity stats on one mesh — and
+    so do jobs the admission policy marked ``processing_mode="direct"``
+    (whole-file mode: VC-1-style codecs, oversize files under
+    ``large_file_behavior="direct"``), which would defeat the split.
 
-    Known follow-up: the shared run() decodes the full clip on the
-    coordinator (parity with LocalExecutor) though the farm path only
-    needs the frame count + audio for the mux; a probe-only run() tail
-    would free coordinator RAM for very long clips."""
+    The shared run() only OPENS the source (streaming ingest,
+    ingest.open_video): the farm path reads the frame count and the
+    audio track for the mux without ever decoding the clip on the
+    coordinator."""
 
     #: wait-loop tick (real time; lease math runs on the injected
     #: clock). The protocol's timescales are seconds — shard leases,
@@ -567,6 +570,53 @@ class RemoteExecutor(LocalExecutor):
 
     # -- encode stage override -----------------------------------------
 
+    #: after the FIRST worker of a cold farm heartbeats, keep waiting
+    #: until the live-worker count has been stable this long before
+    #: planning — staggered daemon restarts re-heartbeat over a few
+    #: seconds (default agent interval is 1 s), and planning on worker
+    #: #1 alone would still produce the degenerate 2-giant-shard plan.
+    SETTLE_S = 2.0
+
+    def _await_first_workers(self, job: Job, token: str, settings) -> None:
+        """Defer shard planning on a COLD farm until claim-capable
+        workers have heartbeated, bounded by
+        `remote_no_worker_grace_s`. A coordinator restart recovers jobs
+        as soon as the API is up (cli.py), usually BEFORE any worker
+        re-heartbeats — and planning against an empty registry
+        degenerates to 2 giant shards on a full farm (the round-2
+        ROADMAP open item). A warm farm (workers already live) plans
+        immediately with zero added latency; a cold one waits for the
+        first heartbeat and then for the worker count to settle
+        (SETTLE_S), so a staggered farm restart is counted whole. On
+        grace expiry planning proceeds anyway; the encode loop's
+        no-live-worker failsafe still fails the job if the farm stays
+        dark."""
+        if self._live_workers():
+            return                      # warm farm: plan now
+        co = self.coordinator
+        grace = float(settings.remote_no_worker_grace_s)
+        settle = min(self.SETTLE_S, grace / 4.0)
+        t0 = self._clock()
+        seen = 0
+        last_change = t0
+
+        def tick(note: str) -> None:
+            if not co.token_is_current(job.id, token):
+                raise HaltedError("stale run token")
+            co.heartbeat_job(job.id, token, "segment", host=self.host,
+                             note=note)
+            time.sleep(self.poll_s)
+
+        while self._clock() - t0 < grace:
+            n = len(self._live_workers())
+            if n != seen:
+                seen = n
+                last_change = self._clock()
+            elif n > 0 and self._clock() - last_change >= settle:
+                return                  # farm width stable: plan
+            tick("waiting for first worker heartbeat" if n == 0 else
+                 f"waiting for the farm to settle ({n} workers)")
+
     def _encode_job(self, job: Job, token: str, frames, settings, meta,
                     stage: list) -> list:
         co = self.coordinator
@@ -577,8 +627,17 @@ class RemoteExecutor(LocalExecutor):
                 "(global QP solve)", job_id=job.id, host=self.host)
             return super()._encode_job(job, token, frames, settings,
                                        meta, stage)
+        if str(getattr(job, "processing_mode", "split") or "split") \
+                == "direct":
+            co.activity.emit(
+                "encode", "direct mode: whole-clip encode on the "
+                "coordinator mesh (admission policy bypasses the farm "
+                "split)", job_id=job.id, host=self.host)
+            return super()._encode_job(job, token, frames, settings,
+                                       meta, stage)
 
         stage[0] = "segment"
+        self._await_first_workers(job, token, settings)
         plan, shards = self._build_shards(job, meta, len(frames), settings)
         co.update_progress(job.id, token, parts_total=plan.num_gops,
                            segment_progress=100.0)
@@ -653,7 +712,12 @@ def encode_shard(desc: Mapping[str, Any], frames, mesh=None
     boundaries and the index/frame offsets re-base the emitted segments
     to global coordinates, so the part is bit-identical to what a
     single-process encode of the whole clip would have produced for
-    these GOPs."""
+    these GOPs.
+
+    `frames` may be a materialized list of the WHOLE clip or a lazy
+    FrameSource (ingest.open_video): slicing a source yields a window
+    that decodes only this shard's [f0, f0+n) frame range — O(shard)
+    decode work and resident memory per claim instead of O(clip)."""
     from ..parallel.dispatch import GopShardEncoder
 
     meta = meta_from_dict(desc["meta"])
@@ -717,13 +781,16 @@ class WorkerClient:
 
 
 class WorkerDaemon:
-    """Claim → decode (cached) → encode → stream-back loop.
+    """Claim → range-decode → encode → stream-back loop.
 
     One daemon per worker host (`python -m thinvids_tpu.cli worker`).
-    The frame cache holds the last `CACHE_CLIPS` decoded inputs keyed by
-    path+signature, so the per-shard cost after the first claim of a
-    job is pure encode — the farm analog of the reference worker's
-    local scratch copy of its segment range."""
+    The source cache holds the last `CACHE_CLIPS` OPENED inputs keyed
+    by path+signature (header/demux state, compressed samples for mp4 —
+    never decoded frames), and each claimed shard decodes only its own
+    [f0, f0+n) frame range through the lazy slice — O(shard) decode
+    work and memory per claim instead of decoding the whole clip to
+    cut out one range (the farm analog of the reference worker's local
+    scratch copy of its segment range)."""
 
     CACHE_CLIPS = 2
 
@@ -742,8 +809,9 @@ class WorkerDaemon:
         self.busy = False
         self.shards_done = 0
         self.shards_failed = 0
-        #: input_path → (signature, decoded frames)
-        self._cache: dict[str, tuple[str, list]] = {}
+        #: input_path → (signature, opened FrameSource — no decoded
+        #: frames cached; shards range-decode on demand)
+        self._cache: dict[str, tuple[str, Any]] = {}
 
     # -- metrics seam (NodeAgent extra_metrics) ------------------------
 
@@ -752,23 +820,27 @@ class WorkerDaemon:
                 "worker_shards_done": self.shards_done,
                 "worker_shards_failed": self.shards_failed}
 
-    # -- decode cache --------------------------------------------------
+    # -- source cache --------------------------------------------------
 
     def _frames(self, input_path: str):
-        from ..ingest.decode import read_video
+        """Open (header parse / demux — NOT decode) the clip, cached by
+        path+signature. The shard slice taken in step() is a lazy
+        window over this source, so each claim decodes only its own
+        [f0, f0+n) frame range."""
+        from ..ingest.decode import open_video
         from ..ingest.watcher import file_signature
 
         sig = file_signature(input_path)
         hit = self._cache.get(input_path)
         if hit is not None and hit[0] == sig:
             return hit[1]
-        _meta, frames, _audio = read_video(input_path)
-        # frames only: the shard encode never touches meta (the shard
+        # source only: the shard encode never touches meta (the shard
         # descriptor carries it) or audio (the coordinator muxes it)
-        self._cache[input_path] = (sig, frames)
+        source = open_video(input_path)
+        self._cache[input_path] = (sig, source)
         while len(self._cache) > self.CACHE_CLIPS:
             self._cache.pop(next(iter(self._cache)))
-        return frames
+        return source
 
     # -- loop ----------------------------------------------------------
 

@@ -6,10 +6,11 @@ appear under a watch root are size-stabilized, checked against a
 durable processed ledger, probed, and submitted as jobs.
 """
 
-from .decode import DecodeError, read_video, supported_exts
+from .decode import (DecodeError, FrameSource, open_video, read_video,
+                     supported_exts)
 from .probe import ProbeError, probe_video
 from .watcher import FileLedger, WatchIngester, coordinator_submitter
 
-__all__ = ["DecodeError", "ProbeError", "probe_video", "read_video",
-           "supported_exts", "FileLedger", "WatchIngester",
-           "coordinator_submitter"]
+__all__ = ["DecodeError", "FrameSource", "ProbeError", "probe_video",
+           "open_video", "read_video", "supported_exts", "FileLedger",
+           "WatchIngester", "coordinator_submitter"]

@@ -250,10 +250,21 @@ class Mp4Media:
     height: int
     timescale: int
     duration_ts: int
-    annexb: bytes                      # SPS+PPS+slices with start codes
     keyflags: list[bool]
     video: Mp4Track
     audio: Mp4Track | None
+
+    @property
+    def annexb(self) -> bytes:
+        """Whole-stream Annex-B (SPS+PPS+slices with start codes).
+
+        LAZY and uncached: built from the samples on each access, so a
+        long-lived Mp4Media (the streaming ingest's per-worker source
+        cache) doesn't pin a second whole-clip copy it never reads —
+        range decodes go through :meth:`annexb_for`. Callers that need
+        the full stream repeatedly should hold the result."""
+        return _avcc_to_annexb(self.video.stsd_entry,
+                               self.video.samples)[0]
 
     @property
     def num_frames(self) -> int:
@@ -267,6 +278,21 @@ class Mp4Media:
             return 30, 1
         delta = max(stts, key=lambda cd: cd[0])[1]
         return self.timescale, max(1, delta)
+
+    def sync_samples(self) -> list[int]:
+        """Sync-sample (keyframe) indices, 0-based, always containing 0
+        (decode has to start at the stream head when nothing earlier is
+        marked). The GOP-range decode grid for streaming ingest."""
+        keys = [i for i, k in enumerate(self.keyflags) if k]
+        return keys if keys and keys[0] == 0 else [0] + keys
+
+    def annexb_for(self, start: int, stop: int) -> bytes:
+        """Annex-B stream of the sample range [start, stop) with the
+        parameter sets prepended — the GOP-range decode unit for
+        streaming ingest (`start` should be a sync sample so the range
+        opens on an IDR)."""
+        return _avcc_to_annexb(self.video.stsd_entry,
+                               self.video.samples[start:stop])[0]
 
 
 def _iter_boxes(buf: bytes, start: int, end: int):
@@ -430,13 +456,11 @@ def demux_mp4(data: bytes) -> Mp4Media:
                              samples=samples)
     if video is None:
         raise ValueError("no video track")
-    annexb, _ = _avcc_to_annexb(video.stsd_entry, video.samples)
     keyflags = [(i + 1 in vsync) if vsync else True
                 for i in range(len(video.samples))]
     return Mp4Media(width=vdims[0], height=vdims[1],
                     timescale=video.timescale, duration_ts=vdur,
-                    annexb=annexb, keyflags=keyflags, video=video,
-                    audio=audio)
+                    keyflags=keyflags, video=video, audio=audio)
 
 
 def read_mp4(path) -> Mp4Media:

@@ -143,6 +143,92 @@ class Y4MWriter:
             self._fp.write(np.ascontiguousarray(frame.v).tobytes())
 
 
+class Y4MRangeReader:
+    """O(1) frame-range access to a .y4m file on disk.
+
+    8-bit y4m frames are fixed-size records (a bare ``FRAME\\n`` marker
+    + a constant plane payload), so frame ``i`` lives at a computable
+    byte offset — the property the streaming ingest pipeline
+    (ingest/decode.py) uses to hand a remote worker ONLY its shard's
+    frame range and to restart iteration per encode pass without
+    re-reading the prefix. Frame-header parameters (``FRAME Ixyz``)
+    would break the arithmetic; they are detected and rejected on read
+    (probe_video already assumes their absence, ingest/probe.py).
+    """
+
+    _MARKER = b"FRAME\n"
+
+    def __init__(self, path: str | os.PathLike) -> None:
+        self.path = os.fspath(path)
+        self._size = os.path.getsize(self.path)
+        with open(self.path, "rb") as fp:
+            header = Y4MReader(fp)
+            self._data_start = fp.tell()
+        self._header = header               # header facts; its fp is closed
+        self._shapes = header._plane_shapes()
+        payload = sum(h * w for h, w in self._shapes)
+        self._record = len(self._MARKER) + payload
+        self.num_frames = max(0, (self._size - self._data_start)
+                              // self._record)
+        # Fail at OPEN time for parameterized frame markers: the
+        # fixed-record arithmetic (shared with probe_video) is wrong
+        # for them, and surfacing that here beats a mid-encode
+        # ValueError after partial work. Mixed files that go bad later
+        # are still caught by the per-frame marker check in
+        # read_range.
+        if self.num_frames > 0:
+            with open(self.path, "rb") as fp:
+                fp.seek(self._data_start)
+                first = fp.read(len(self._MARKER))
+            if first != self._MARKER:
+                raise ValueError(
+                    f"{self.path}: first frame marker {first!r} is not "
+                    f"a bare FRAME record — parameterized y4m frame "
+                    f"headers are unsupported by the streaming reader "
+                    f"(probe_video makes the same assumption)")
+
+    @property
+    def meta(self) -> VideoMeta:
+        h = self._header
+        return VideoMeta(
+            width=h.width, height=h.height,
+            fps_num=h.fps_num, fps_den=h.fps_den,
+            num_frames=self.num_frames, chroma=h.chroma,
+            codec="rawvideo",
+            duration_s=self.num_frames / h.meta.fps if h.meta.fps else 0.0,
+            size_bytes=self._size,
+        )
+
+    def read_range(self, start: int, stop: int) -> Iterator[Frame]:
+        """Yield frames [start, stop) straight from their byte offsets.
+        Each call opens its own file handle, so concurrent iterations
+        (an encode pass overlapping an analysis pass) never share a
+        cursor."""
+        start = max(0, start)
+        stop = min(self.num_frames, stop)
+        if stop <= start:
+            return
+        with open(self.path, "rb") as fp:
+            fp.seek(self._data_start + start * self._record)
+            for idx in range(start, stop):
+                marker = fp.read(len(self._MARKER))
+                if marker != self._MARKER:
+                    raise ValueError(
+                        f"{self.path}: frame {idx} marker {marker!r} is "
+                        f"not a bare FRAME record (parameterized y4m "
+                        f"frame headers are unsupported for range reads)")
+                planes = []
+                for h, w in self._shapes:
+                    data = fp.read(h * w)
+                    if len(data) != h * w:
+                        raise EOFError("truncated y4m frame payload")
+                    planes.append(np.frombuffer(data, np.uint8).reshape(h, w))
+                y = planes[0]
+                u, v = ((planes[1], planes[2]) if len(planes) == 3
+                        else (None, None))
+                yield Frame(y, u, v, pts=idx)
+
+
 def read_y4m(path: str | os.PathLike) -> tuple[VideoMeta, list[Frame]]:
     with open(path, "rb") as fp:
         reader = Y4MReader(fp)

@@ -11,13 +11,22 @@ the single-device encode is asserted by tests/test_parallel.py on an
 8-device virtual mesh.
 
 Host side, the pipeline is instrumented per stage (StageProfile): every
-wave's dispatch / device wait / D2H fetch / sparse unpack / unflatten /
-CAVLC pack / concat wall-clock accumulates on the encoder and is exported
-through bench.py (`stage_ms`) and the API's /metrics_snapshot. The
-entropy pack fans out at SLICE granularity across a per-encoder pool
-sized by `pack_workers` (TVT_PACK_WORKERS; default: all cores; threads
-spawn on demand and retire with the encoder), decoupled from the
-in-flight wave window `pipeline_window` (TVT_PIPELINE_WINDOW).
+wave's source decode / staging (stack + H2D upload) / dispatch / device
+wait / D2H fetch / sparse unpack / unflatten / CAVLC pack / concat
+wall-clock accumulates on the encoder and is exported through bench.py
+(`stage_ms`) and the API's /metrics_snapshot. The entropy pack fans out
+at SLICE granularity across a per-encoder pool sized by `pack_workers`
+(TVT_PACK_WORKERS; default: all cores; threads spawn on demand and
+retire with the encoder), decoupled from the in-flight wave window
+`pipeline_window` (TVT_PIPELINE_WINDOW).
+
+Ingest is a pipelined stage, not a blocking prologue: `stage_waves`
+accepts a streaming FrameSource (ingest.open_video) or a materialized
+list and holds only the current wave's decoded frames (a sliding
+_FrameCursor window), and :func:`background_stage` runs the whole
+decode→stack→upload chain on a staging thread up to `decode_ahead`
+waves (TVT_DECODE_AHEAD) ahead of dispatch, overlapping source decode
+with device compute.
 """
 
 from __future__ import annotations
@@ -34,9 +43,12 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from collections import deque
+
 from ..core.config import get_settings
 from ..core.devices import shard_map
-from ..core.types import EncodedSegment, Frame, GopSpec, SegmentPlan, VideoMeta
+from ..core.types import (ChromaFormat, EncodedSegment, Frame, GopSpec,
+                          SegmentPlan, VideoMeta)
 from ..codecs.h264 import jaxcore
 from ..codecs.h264.encoder import gop_slice_thunks_planes, pack_slice
 from ..codecs.h264.headers import PPS, SPS
@@ -56,9 +68,11 @@ def default_mesh(devices=None) -> Mesh:
 
 # ---- host-stage wall-clock instrumentation --------------------------------
 
-#: canonical stage keys, in pipeline order
-STAGE_NAMES = ("dispatch", "device_wait", "fetch", "sparse_unpack",
-               "unflatten", "pack", "concat")
+#: canonical stage keys, in pipeline order (decode = pulling frames
+#: from the ingest source; stage = stack + H2D upload — both run on
+#: the staging thread when background_stage wraps the generator)
+STAGE_NAMES = ("decode", "stage", "dispatch", "device_wait", "fetch",
+               "sparse_unpack", "unflatten", "pack", "concat")
 
 
 class StageProfile:
@@ -120,6 +134,121 @@ def stage_snapshot() -> dict:
     here (the /metrics_snapshot exporter — running jobs' waves land as
     they complete, and finished jobs' totals persist)."""
     return _TOTALS.snapshot()
+
+
+class _FrameCursor:
+    """Sliding decoded-frame window for wave staging.
+
+    Pulls frames on demand from a materialized list or a streaming
+    FrameSource (anything exposing ``iter_frames()``), pads them to
+    macroblock multiples, and retains only ``[lo, hi)`` — the staging
+    loop releases everything below the staged wave's end, so resident
+    decoded frames stay bounded by one wave regardless of clip length
+    (the paper's never-hold-a-whole-clip invariant)."""
+
+    def __init__(self, frames, profile: StageProfile,
+                 require_420: bool = False,
+                 stats: dict | None = None) -> None:
+        iter_fn = getattr(frames, "iter_frames", None)
+        self._it = iter_fn() if iter_fn is not None else iter(frames)
+        self._profile = profile
+        self._require_420 = require_420
+        self._stats = stats if stats is not None else {}
+        self._buf: deque = deque()      # padded frames [lo, hi)
+        self._lo = 0
+        self._hi = 0
+
+    def get(self, i: int) -> Frame:
+        """Padded frame at absolute index `i` (must not be released)."""
+        if i < self._lo:
+            raise IndexError(
+                f"frame {i} already released (window starts at "
+                f"{self._lo})")
+        while self._hi <= i:
+            with self._profile.stage("decode"):
+                try:
+                    f = next(self._it)
+                except StopIteration:
+                    raise ValueError(
+                        f"frame stream ended at {self._hi}, but the "
+                        f"wave plan needs frame {i}") from None
+            if self._require_420 and f.chroma is not ChromaFormat.YUV420:
+                raise ValueError(
+                    f"GopShardEncoder supports only 4:2:0 input, got "
+                    f"{f.chroma.name}; convert before encoding")
+            self._buf.append(f.padded(16))
+            self._hi += 1
+            if len(self._buf) > self._stats.get("peak_resident_frames", 0):
+                self._stats["peak_resident_frames"] = len(self._buf)
+        return self._buf[i - self._lo]
+
+    def release_below(self, i: int) -> None:
+        while self._lo < i and self._buf:
+            self._buf.popleft()
+            self._lo += 1
+
+
+def background_stage(staged_waves, decode_ahead: int = 2):
+    """Run a staging generator (stage_waves: source decode + np.stack +
+    H2D upload) on its own thread, up to `decode_ahead` staged waves
+    ahead of the consumer — ingest becomes a pipelined stage that
+    overlaps device compute instead of a blocking prologue on the
+    dispatch thread.
+
+    Each queued wave is ALREADY H2D-uploaded: device-side input
+    residency is the consumer's in-flight window plus `decode_ahead`
+    (+1 blocked in the put) waves of HBM YUV arrays — size the knob
+    against HBM headroom, not just source latency.
+
+    Returns a generator yielding the staged tuples in order; close()
+    (or exhaustion, or an exception propagating out) stops the staging
+    thread and releases its decode window. Exceptions raised while
+    staging (bad chroma, truncated source) re-raise at the consumer's
+    next pull."""
+    import queue as queue_mod
+
+    q: queue_mod.Queue = queue_mod.Queue(max(1, int(decode_ahead)))
+    stop = threading.Event()
+    done = object()
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
+    def feed() -> None:
+        try:
+            for staged in staged_waves:
+                if not _put(staged):
+                    return
+            _put(done)
+        except BaseException as exc:    # noqa: BLE001 - relay to consumer
+            _put(exc)
+        finally:
+            close = getattr(staged_waves, "close", None)
+            if close is not None:
+                close()
+
+    thread = threading.Thread(target=feed, daemon=True, name="tvt-stage")
+
+    def drain():
+        thread.start()
+        try:
+            while True:
+                item = q.get()
+                if item is done:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+
+    return drain()
 
 
 def _sparse_unpack2_host(nblk: int, nval: int, bitmap, bmask16, vals,
@@ -364,7 +493,8 @@ class GopShardEncoder:
                  gop_frames: int = 32, max_segments: int = 200,
                  inter: bool = True, gops_per_wave: int = 4,
                  pack_workers: int | None = None,
-                 pipeline_window: int | None = None):
+                 pipeline_window: int | None = None,
+                 decode_ahead: int | None = None):
         self.meta = meta
         self.qp = qp
         #: inter=True encodes each GOP as IDR + P frames (motion-coded);
@@ -392,8 +522,18 @@ class GopShardEncoder:
         if pipeline_window is None:
             pipeline_window = int(snap.get("pipeline_window", 0) or 0)
         self.pipeline_window = int(pipeline_window) or self.PIPELINE_WINDOW
+        #: staged waves decoded + uploaded ahead of dispatch by the
+        #: background staging thread (encode() / background_stage).
+        #: ADDS to input HBM residency on top of the in-flight window
+        #: (each staged-ahead wave is already uploaded).
+        if decode_ahead is None:
+            decode_ahead = int(snap.get("decode_ahead", 0) or 0)
+        self.decode_ahead = int(decode_ahead) or self.DECODE_AHEAD
         #: per-stage host wall-clock (bench `stage_ms`, /metrics_snapshot)
         self.stages = StageProfile(mirror=_TOTALS)
+        #: streaming-ingest instrumentation: peak decoded frames the
+        #: staging cursor held at once (tests assert the bound)
+        self.staging_stats: dict = {"peak_resident_frames": 0}
         #: eager so concurrent collect_wave threads never race a lazy
         #: init; the executor spawns NO threads until first submit
         self._pack_pool = self._new_pack_pool()
@@ -423,44 +563,61 @@ class GopShardEncoder:
         return plan_segments(num_frames, self.gop_frames, self.num_devices,
                              self.max_segments)
 
-    def stage_waves(self, frames: list[Frame]):
+    def stage_waves(self, frames):
         """Host-side staging generator: stack frames into per-wave
         (G, F, H, W) device arrays (HBM-resident input is the design
         invariant — SURVEY.md §0: kernels run over HBM-resident YUV
         planes). Lazily, one wave per iteration, so a long clip never
-        pins more than the pipeline window of waves in HBM."""
-        from ..core.types import ChromaFormat
+        pins more than the pipeline window of waves in HBM.
 
-        bad = next((f for f in frames
-                    if f.chroma is not ChromaFormat.YUV420), None)
-        if bad is not None:
-            raise ValueError(
-                f"GopShardEncoder supports only 4:2:0 input, got "
-                f"{bad.chroma.name}; convert before encoding")
-        for wave, full, F, padded in self._wave_groups(frames):
-            ys = np.stack([self._gop_plane(padded, g, F, "y") for g in full])
-            us = np.stack([self._gop_plane(padded, g, F, "u") for g in full])
-            vs = np.stack([self._gop_plane(padded, g, F, "v") for g in full])
-            qps = np.asarray([self.gop_qp.get(g.index, self.qp)
-                              for g in full], np.int32)
-            yield (wave, jnp.asarray(ys), jnp.asarray(us), jnp.asarray(vs),
-                   jnp.asarray(qps))
+        `frames` may be a materialized list or a streaming FrameSource
+        (ingest.open_video); either way only the current wave's decoded
+        frames stay resident (_FrameCursor). Wrap the result in
+        :func:`background_stage` — or use :meth:`encode` — to run the
+        decode + stack + H2D upload on a staging thread ahead of the
+        dispatch loop."""
+        for wave, full, F, cursor in self._wave_groups(frames,
+                                                       require_420=True):
+            # prefetch the wave's frames OUTSIDE the "stage" timer so
+            # the breakdown keeps decode (source pull) and stage
+            # (stack + H2D) disjoint — cursor.get runs its own
+            # "decode"-staged fill
+            cursor.get(wave[-1].end_frame - 1)
+            with self.stages.stage("stage"):
+                ys = np.stack([self._gop_plane(cursor, g, F, "y")
+                               for g in full])
+                us = np.stack([self._gop_plane(cursor, g, F, "u")
+                               for g in full])
+                vs = np.stack([self._gop_plane(cursor, g, F, "v")
+                               for g in full])
+                qps = np.asarray([self.gop_qp.get(g.index, self.qp)
+                                  for g in full], np.int32)
+                staged = (wave, jnp.asarray(ys), jnp.asarray(us),
+                          jnp.asarray(vs), jnp.asarray(qps))
+            yield staged
 
-    def stage_luma_waves(self, frames: list[Frame]):
+    def stage_luma_waves(self, frames):
         """Luma-only staging for analysis passes (rate control): chroma
         never leaves the host, halving the upload of a pass that only
         reads Y. Yields (wave, ys)."""
-        for wave, full, F, padded in self._wave_groups(frames):
-            ys = np.stack([self._gop_plane(padded, g, F, "y") for g in full])
-            yield (wave, jnp.asarray(ys))
+        for wave, full, F, cursor in self._wave_groups(frames):
+            cursor.get(wave[-1].end_frame - 1)   # decode outside "stage"
+            with self.stages.stage("stage"):
+                ys = np.stack([self._gop_plane(cursor, g, F, "y")
+                               for g in full])
+                staged = (wave, jnp.asarray(ys))
+            yield staged
 
-    def _wave_groups(self, frames: list[Frame]):
+    def _wave_groups(self, frames, require_420: bool = False):
         """Shared wave grouping: (wave, device-padded wave, static F,
-        padded frames). Stacks into (G, F, ...) with tail-repeat padding
+        frame cursor). Stacks into (G, F, ...) with tail-repeat padding
         to static F; the wave itself pads to a multiple of D gops (the
-        pad GOPs are encoded then discarded)."""
+        pad GOPs are encoded then discarded). The cursor decodes frames
+        on demand and each wave's frames are released once the caller
+        has staged them into device arrays."""
         plan = self.plan(len(frames))
-        padded = [f.padded(16) for f in frames]
+        cursor = _FrameCursor(frames, self.stages, require_420=require_420,
+                              stats=self.staging_stats)
         D = self.num_devices
         per_wave = D * (self.gops_per_wave if self.inter else 1)
         gops = list(plan.gops)
@@ -469,16 +626,25 @@ class GopShardEncoder:
             F = max(g.num_frames for g in wave)
             pad_n = (-len(wave)) % D
             full = wave + [wave[-1]] * pad_n
-            yield wave, full, F, padded
+            yield wave, full, F, cursor
+            # the caller staged this wave into device arrays; frames
+            # below the next wave's start will never be read again
+            cursor.release_below(wave[-1].end_frame)
 
-    def prepare_waves(self, frames: list[Frame]
-                      ) -> tuple[SegmentPlan, list[tuple]]:
+    def prepare_waves(self, frames) -> tuple[SegmentPlan, list[tuple]]:
         """Eager staging of ALL waves (benchmarks / short clips); for
         long clips prefer encode(), which streams with a bounded window."""
         return self.plan(len(frames)), list(self.stage_waves(frames))
 
-    def encode(self, frames: list[Frame]) -> list[EncodedSegment]:
-        return self.encode_waves(self.stage_waves(frames))
+    def encode(self, frames) -> list[EncodedSegment]:
+        """Stream-encode: source decode + staging run on a background
+        thread up to `decode_ahead` waves ahead (background_stage);
+        dispatch/collect pipeline on the calling thread."""
+        feed = background_stage(self.stage_waves(frames), self.decode_ahead)
+        try:
+            return self.encode_waves(feed)
+        finally:
+            feed.close()
 
     def dispatch_wave(self, staged: tuple) -> tuple:
         """Enqueue one staged wave's device compute (async); returns an
@@ -655,6 +821,11 @@ class GopShardEncoder:
     #: the `pipeline_window` setting (TVT_PIPELINE_WINDOW) override it.
     PIPELINE_WINDOW = 4
 
+    #: default staged-waves-ahead depth for the background staging
+    #: thread when neither the constructor nor the `decode_ahead`
+    #: setting (TVT_DECODE_AHEAD) override it.
+    DECODE_AHEAD = 2
+
     def encode_waves(self, waves, window: int | None = None,
                      pack_workers: int | None = None
                      ) -> list[EncodedSegment]:
@@ -702,10 +873,10 @@ class GopShardEncoder:
         return segments
 
     @staticmethod
-    def _gop_plane(padded: list[Frame], gop: GopSpec, F: int, plane: str
+    def _gop_plane(cursor: _FrameCursor, gop: GopSpec, F: int, plane: str
                    ) -> np.ndarray:
-        arrs = [getattr(padded[i], plane) for i in range(gop.start_frame,
-                                                        gop.end_frame)]
+        arrs = [getattr(cursor.get(i), plane)
+                for i in range(gop.start_frame, gop.end_frame)]
         while len(arrs) < F:            # tail-repeat to the wave's static F
             arrs.append(arrs[-1])
         return np.stack(arrs)
