@@ -12,13 +12,19 @@ import bench
 
 
 def test_run_pipeline_reports_stage_breakdown():
-    from thinvids_tpu.parallel.dispatch import STAGE_NAMES
+    from thinvids_tpu.parallel.dispatch import STAGE_COUNTERS, STAGE_NAMES
 
     r = bench._run_pipeline(64, 48, nframes=4, qp=27, gop_frames=2,
                             quality=False)
     assert r["fps"] > 0 and r["device_fps"] > 0 and r["bytes"] > 0
     for key in STAGE_NAMES:
         assert key in r["stage_ms"]
+    # the boundary counters ride in the same snapshot: actual D2H
+    # traffic (bench reports it per frame) + the dense-fallback and
+    # per-shard-fetch tallies
+    for key in STAGE_COUNTERS:
+        assert key in r["stage_ms"]
+    assert r["stage_ms"]["d2h_bytes"] > 0
     assert r["stage_ms"]["waves"] >= 1
 
 
@@ -35,7 +41,8 @@ def test_bench_result_schema_includes_stage_ms():
     from thinvids_tpu.parallel.dispatch import STAGE_NAMES
 
     r = {"fps": 33.3, "device_fps": 50.0, "bytes": 1200,
-         "stage_ms": {k: 1.0 for k in STAGE_NAMES} | {"waves": 2},
+         "stage_ms": {k: 1.0 for k in STAGE_NAMES}
+         | {"waves": 2, "d2h_bytes": 6400},
          "quality": {"psnr_y": 40.1, "ssim_y": 0.99}}
     r4k = {"fps": 2.8, "device_fps": 7.0, "bytes": 9000,
            "stage_ms": {}, "quality": {"psnr_y": 41.0, "ssim_y": 0.98}}
@@ -46,6 +53,13 @@ def test_bench_result_schema_includes_stage_ms():
     assert result["value"] == 33.3
     assert result["fps_2160p"] == 2.8
     assert set(STAGE_NAMES) <= set(result["stage_ms"])
+    # dense_retry is a first-class stage (not folded into fetch)
+    assert "dense_retry" in result["stage_ms"]
+    # the device→host boundary is a pinned, regression-checked metric:
+    # e2e ÷ device fps per resolution + measured D2H bytes per frame
+    assert result["host_gap_1080p"] == round(33.3 / 50.0, 3)
+    assert result["host_gap_2160p"] == round(2.8 / 7.0, 3)
+    assert result["d2h_bytes_per_frame"] == 100    # 6400 B / 64 frames
     # streaming-ingest stages are first-class schema keys
     assert "decode" in result["stage_ms"] and "stage" in result["stage_ms"]
     # cold end-to-end figure (decode -> encode -> concat, nothing

@@ -539,8 +539,10 @@ class ApiServer:
                    for w in self.coordinator.registry.all()}
         out: dict[str, Any] = {"metrics": metrics}
         # Host encode-stage breakdown (decode / stage / dispatch /
-        # device wait / fetch / sparse unpack / unflatten / pack /
-        # concat wall-clock ms) for
+        # device wait / fetch / dense_retry / sparse unpack / unflatten
+        # / pack / concat wall-clock ms) plus the boundary counters
+        # (dense_fallback_waves, d2h_bytes, fetch_shards,
+        # proc_pack_gops — parallel/dispatch.STAGE_COUNTERS) for
         # every live encoder in this process. Read through sys.modules:
         # if no encoder ever ran here (e.g. a pure-manager node), don't
         # drag jax in just to report an empty dict.

@@ -717,7 +717,14 @@ def encode_shard(desc: Mapping[str, Any], frames, mesh=None
     `frames` may be a materialized list of the WHOLE clip or a lazy
     FrameSource (ingest.open_video): slicing a source yields a window
     that decodes only this shard's [f0, f0+n) frame range — O(shard)
-    decode work and resident memory per claim instead of O(clip)."""
+    decode work and resident memory per claim instead of O(clip).
+
+    The encoder is built from this process's settings snapshot, so a
+    worker inherits the full collect path — compact device→host level
+    transfer (TVT_COMPACT_TRANSFER), per-shard concurrent fetch, and
+    the pack backend (TVT_PACK_BACKEND) — from its own environment;
+    output stays bit-identical to the coordinator's plan regardless of
+    which transfer/pack path each worker takes (parity-tested)."""
     from ..parallel.dispatch import GopShardEncoder
 
     meta = meta_from_dict(desc["meta"])

@@ -478,18 +478,51 @@ def block_sparse2_fits(nblk: int, nval: int, n_esc: int, L: int,
 def _block_sparse_unpack2(nblk: int, nval: int, bitmap: np.ndarray,
                           bmask16: np.ndarray, vals: np.ndarray,
                           L: int) -> np.ndarray:
-    """Host inverse of _block_sparse_pack2 → flat int16 levels."""
-    NB = -(-L // _BLOCK)
-    bm = np.unpackbits(bitmap)[:NB].astype(bool)
-    masks = bmask16[:nblk].astype(np.uint32)
-    lane_bits = ((masks[:, None] >> np.arange(_BLOCK, dtype=np.uint32))
-                 & 1).astype(bool)                      # (nblk, 16)
-    stream = vals[:nval].astype(np.int16)
-    rows = np.zeros((nblk, _BLOCK), np.int16)
-    rows[lane_bits] = stream        # row-major = (block, lane) order
-    out = np.zeros((NB, _BLOCK), np.int16)
-    out[bm] = rows
-    return out.reshape(-1)[:L]
+    """Host inverse of _block_sparse_pack2 → flat int16 levels (the
+    single numpy implementation lives in the jax-free layout module so
+    the process pack sidecars can share it)."""
+    from .layout import block_sparse_unpack2_host
+
+    return block_sparse_unpack2_host(nblk, nval, bitmap, bmask16, vals, L)
+
+
+def _compact_stream(nblk, nval, bitmap, bmask16, vals):
+    """Device-side stream compaction (tier 3 of the transfer pack):
+    concatenate the two-tier sparse streams into ONE dense uint8
+    payload per GOP, so the bulk fetch moves a single compact byte
+    array instead of three budget-padded int arrays.
+
+    Layout (layout.split_compact is the host parser):
+
+        [ bitmap (nb8 bytes) | bmask16 as little-endian byte pairs,
+          first nblk live entries | vals, first nval entries ]
+
+    The vals section lands RIGHT AFTER the live bmask16 entries via a
+    dynamic_update_slice at offset nb8 + 2*nblk, so the used prefix —
+    ``used = nb8 + 2*nblk + nval`` bytes, returned alongside — is
+    contiguous: the host fetches ``payload[:, :used_max]`` (quantized,
+    parallel/dispatch) and the padding tail never crosses the link.
+    There is no escape section: levels beyond ±127 have no side-channel
+    in _block_sparse_pack2 (n_esc > 0 forces the wave-wide dense
+    fallback before any payload is read).
+
+    Returns (used int32, payload uint8[nb8 + 2*budget + vbudget]).
+    """
+    nb8 = bitmap.shape[0]
+    budget = bmask16.shape[0]
+    lo = (bmask16 & jnp.uint16(0xFF)).astype(jnp.uint8)
+    hi = (bmask16 >> 8).astype(jnp.uint8)
+    mb = jnp.stack([lo, hi], axis=1).reshape(-1)         # (2*budget,)
+    vals_u8 = jax.lax.bitcast_convert_type(vals, jnp.uint8)
+    payload = jnp.concatenate(
+        [bitmap, mb, jnp.zeros(vals.shape[0], jnp.uint8)])
+    # Live bmask16 entries occupy [nb8, nb8 + 2*nblk); the dead tail of
+    # `mb` beyond that is all-zero (pack2 zeroes dead gathered rows), so
+    # overwriting it with the vals stream loses nothing.
+    payload = jax.lax.dynamic_update_slice(
+        payload, vals_u8, ((nb8 + 2 * nblk).astype(jnp.int32),))
+    used = (nb8 + 2 * nblk + nval).astype(jnp.int32)
+    return used, payload
 
 
 def _block_sparse_unpack(nblk: int, n_esc: int, bitmap: np.ndarray,

@@ -308,9 +308,11 @@ def encode_gop_jit(ys, us, vs, qp, *, mbw: int, mbh: int,
 # Per-MB flat sizes for the plane-layout GOP transfer: the P part of the
 # flat vector is (F-1) * nmb * _P_FLAT_MB int16 values laid out
 # struct-of-arrays: all luma coeff planes, then u DC, v DC (hadamard
-# domain), then u AC, v AC coeff planes (DC positions zeroed).
-_P_FLAT_MB = 256 + 4 + 4 + 64 + 64        # = 392
-_INTRA_FLAT_MB = 384
+# domain), then u AC, v AC coeff planes (DC positions zeroed). The
+# values live in the jax-free layout module (the host inverses and the
+# process pack sidecars read them without dragging jax in); re-exported
+# here next to the encode that emits the layout.
+from .layout import _INTRA_FLAT_MB, _P_FLAT_MB  # noqa: E402
 
 
 def encode_gop_planes(ys, us, vs, qp, *, mbw: int, mbh: int):

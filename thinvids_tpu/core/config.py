@@ -64,6 +64,16 @@ DEFAULT_SETTINGS: dict[str, Any] = {
     # the window to device queue depth / HBM budget.
     "pack_workers": 0,
     "pipeline_window": 4,
+    # device→host boundary (parallel/dispatch.py): compact_transfer
+    # folds each GOP's sparse level streams into one byte payload ON
+    # DEVICE so the bulk fetch moves only the used bytes
+    # (TVT_COMPACT_TRANSFER=0 restores the three-array sparse2
+    # transfer — the validated fallback, bit-identical output);
+    # pack_backend=process opts into shared-memory pack sidecar
+    # processes (TVT_PACK_BACKEND) that run unpack+pack outside the
+    # coordinator's GIL — the 4K host-pack ceiling.
+    "compact_transfer": True,
+    "pack_backend": "thread",        # thread | process
     # streaming ingest (ingest/decode.py + parallel/dispatch.py):
     # staged waves the background staging thread decodes + uploads
     # ahead of dispatch (TVT_DECODE_AHEAD). Each staged-ahead wave is
@@ -152,6 +162,9 @@ _CLAMPS: dict[str, Callable[[Any], Any]] = {
     "rc_mode": lambda v: str(v) if str(v) in ("cqp", "vbr2pass") else "cqp",
     "pack_workers": lambda v: min(256, max(0, as_int(v, 0))),
     "pipeline_window": lambda v: min(64, max(1, as_int(v, 4))),
+    "pack_backend": lambda v: str(v)
+    if str(v) in ("thread", "process")
+    else "thread",
     # capped well below pipeline_window's 64: every staged-ahead wave
     # pins HBM-resident input arrays (see DEFAULT_SETTINGS note)
     "decode_ahead": lambda v: min(16, max(1, as_int(v, 2))),

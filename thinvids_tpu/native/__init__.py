@@ -64,12 +64,21 @@ def _build_and_load() -> ctypes.CDLL:
             if (not os.path.exists(_SO)
                     or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
                 os.makedirs(_BUILD_DIR, exist_ok=True)
-                tmp = _SO + ".tmp"
-                subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                     _SRC, "-o", tmp],
-                    check=True, capture_output=True, timeout=120)
-                os.replace(tmp, _SO)
+                # pid-unique tmp: concurrent builders (spawned pack
+                # sidecars racing a fresh checkout) each compile their
+                # own file and atomically replace — last wins, every
+                # one valid. A shared tmp let builder B keep writing
+                # into the inode builder A had already renamed to _SO.
+                tmp = _SO + f".tmp.{os.getpid()}"
+                try:
+                    subprocess.run(
+                        ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                         _SRC, "-o", tmp],
+                        check=True, capture_output=True, timeout=120)
+                    os.replace(tmp, _SO)
+                finally:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
             lib = ctypes.CDLL(_SO)
         except (OSError, subprocess.SubprocessError) as exc:
             _load_failed = f"native packer unavailable: {exc}"
@@ -93,6 +102,12 @@ def _build_and_load() -> ctypes.CDLL:
             ctypes.c_int32, ctypes.c_int32,             # nblk, nval
             ctypes.c_void_p, ctypes.c_void_p,           # bitmap, bmask16
             ctypes.c_void_p,                            # vals
+            ctypes.c_void_p, ctypes.c_int64,            # out, L
+        ]
+        lib.cavlc_unpack_compact.restype = ctypes.c_int64
+        lib.cavlc_unpack_compact.argtypes = [
+            ctypes.c_int32, ctypes.c_int32,             # nblk, nval
+            ctypes.c_void_p, ctypes.c_int64,            # payload, len
             ctypes.c_void_p, ctypes.c_int64,            # out, L
         ]
         lib.cavlc_init_inter.argtypes = [ctypes.c_void_p]
@@ -290,4 +305,26 @@ def block_sparse_unpack2(nblk: int, nval: int, bitmap: np.ndarray,
         vals.ctypes.data, out.ctypes.data, L)
     if rc != 0:
         raise ValueError("sparse level stream inconsistent with counts")
+    return out[:L]
+
+
+def unpack_compact(nblk: int, nval: int, payload: np.ndarray,
+                   L: int) -> np.ndarray:
+    """Native inverse of jaxcore._compact_stream: ONE contiguous compact
+    payload (bitmap | bmask16 byte pairs | int8 vals — format pinned in
+    codecs/h264/layout.py) → flat int16 levels, parsed in C with no
+    intermediate stream views (layout.unpack_compact_host is the
+    no-compiler fallback and the parity reference)."""
+    lib = _build_and_load()
+    payload = np.ascontiguousarray(payload, np.uint8)
+    NB = -(-L // 16)
+    # np.zeros = calloc, same lazy-zero-page contract as above
+    out = np.zeros(NB * 16, np.int16)
+    rc = lib.cavlc_unpack_compact(
+        int(nblk), int(nval), payload.ctypes.data, payload.nbytes,
+        out.ctypes.data, L)
+    if rc == -2:
+        raise ValueError("compact payload truncated for its counts")
+    if rc != 0:
+        raise ValueError("compact level stream inconsistent with counts")
     return out[:L]

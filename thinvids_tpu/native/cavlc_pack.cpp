@@ -311,6 +311,51 @@ static int64_t pack_islice_impl(
   return emit_ebsp(bw, out, out_cap);
 }
 
+// Shared scatter core of the two sparse-stream unpack entries: bitmap
+// (1 bit/16-coeff block, big-endian within bytes) + per-live-block
+// uint16 lane masks (via `mask_at(i)` — aligned uint16 reads for the
+// array entry, byte-pair reads for the compact payload) + the packed
+// nonzero values -> flat int16 levels in `out` (L coeffs; the caller
+// allocates ceil(L/16)*16 so the tail block never lands out of
+// bounds). One O(nval) scatter instead of numpy's three boolean index
+// passes over the full vector (~25 M coeffs per 1080p GOP). `out` MUST
+// arrive zeroed — the Python wrappers hand a fresh np.zeros (calloc)
+// buffer, so the zero fill is lazy OS zero-pages instead of a 50 MB
+// memset per GOP. Returns 0, or -1 when the streams disagree with the
+// counts (corrupt transfer).
+template <typename MaskAt>
+static int64_t sparse_unpack2_core(int32_t nblk, int32_t nval,
+                                   const uint8_t* bitmap, MaskAt mask_at,
+                                   const int8_t* vals, int16_t* out,
+                                   int64_t L) {
+  const int64_t NB = (L + 15) / 16;
+  int32_t bi = 0, vi = 0;
+  int64_t b = 0;
+  for (; b < NB && bi < nblk; b++) {
+    if (!(bitmap[b >> 3] & (0x80u >> (b & 7)))) continue;
+    uint32_t m = mask_at(bi++);
+    if (vi + __builtin_popcount(m) > nval) return -1;
+    int16_t* o = out + b * 16;
+    while (m) {
+      const int k = __builtin_ctz(m);
+      m &= m - 1;
+      o[k] = vals[vi++];
+    }
+  }
+  if (bi != nblk || vi != nval) return -1;
+  // Any set bit AFTER the nblk-th live block is a corrupt bitmap too —
+  // it must fail loudly like the numpy reference, not decode those
+  // blocks as silent zeros. Byte-granular tail scan.
+  const int64_t nbytes = (NB + 7) / 8;
+  int64_t byte = b >> 3;
+  if (byte < nbytes) {
+    if (bitmap[byte] & (0xFFu >> (b & 7))) return -1;
+    for (byte++; byte < nbytes; byte++)
+      if (bitmap[byte]) return -1;
+  }
+  return 0;
+}
+
 }  // namespace
 
 extern "C" {
@@ -350,46 +395,40 @@ int64_t cavlc_pack_islice16(
                           chroma_ac, mbw, mbh, out, out_cap);
 }
 
-// Host inverse of jaxcore._block_sparse_pack2: bitmap (1 bit/16-coeff
-// block, big-endian within bytes) + per-live-block uint16 lane masks +
-// the packed nonzero values -> flat int16 levels in `out` (L coeffs; the
-// caller allocates ceil(L/16)*16 so the tail block never lands out of
-// bounds). The numpy version built three boolean index passes over the
-// full vector (~25 M coeffs per 1080p GOP); this is one O(nval)
-// scatter. `out` MUST arrive zeroed — the Python wrapper hands a fresh
-// np.zeros (calloc) buffer, so the zero fill is lazy OS zero-pages
-// instead of a 50 MB memset per GOP. Returns 0, or -1 when the streams
-// disagree with the counts (corrupt transfer).
+// Host inverse of jaxcore._block_sparse_pack2 over the three separate
+// budget-padded arrays (the non-compact transfer path).
 int64_t cavlc_sparse_unpack2(
     int32_t nblk, int32_t nval,
     const uint8_t* bitmap, const uint16_t* bmask16, const int8_t* vals,
     int16_t* out, int64_t L) {
+  return sparse_unpack2_core(
+      nblk, nval, bitmap,
+      [bmask16](int32_t i) { return (uint32_t)bmask16[i]; }, vals, out, L);
+}
+
+// Host inverse of jaxcore._compact_stream: ONE contiguous payload
+// (bitmap | bmask16 little-endian byte pairs | int8 vals — see
+// codecs/h264/layout.py for the format) -> flat int16 levels, no
+// intermediate stream views or copies. The lane masks are read as byte
+// pairs because the vals section's start (nb8 + 2*nblk) gives the
+// payload no alignment guarantee. Returns 0, -1 on count/stream
+// disagreement, -2 when the payload is shorter than the counts demand.
+int64_t cavlc_unpack_compact(
+    int32_t nblk, int32_t nval,
+    const uint8_t* payload, int64_t payload_len,
+    int16_t* out, int64_t L) {
   const int64_t NB = (L + 15) / 16;
-  int32_t bi = 0, vi = 0;
-  int64_t b = 0;
-  for (; b < NB && bi < nblk; b++) {
-    if (!(bitmap[b >> 3] & (0x80u >> (b & 7)))) continue;
-    uint32_t m = bmask16[bi++];
-    if (vi + __builtin_popcount(m) > nval) return -1;
-    int16_t* o = out + b * 16;
-    while (m) {
-      const int k = __builtin_ctz(m);
-      m &= m - 1;
-      o[k] = vals[vi++];
-    }
-  }
-  if (bi != nblk || vi != nval) return -1;
-  // Any set bit AFTER the nblk-th live block is a corrupt bitmap too —
-  // it must fail loudly like the numpy reference, not decode those
-  // blocks as silent zeros. Byte-granular tail scan.
-  const int64_t nbytes = (NB + 7) / 8;
-  int64_t byte = b >> 3;
-  if (byte < nbytes) {
-    if (bitmap[byte] & (0xFFu >> (b & 7))) return -1;
-    for (byte++; byte < nbytes; byte++)
-      if (bitmap[byte]) return -1;
-  }
-  return 0;
+  const int64_t nb8 = (NB + 7) / 8;
+  if (payload_len < nb8 + 2 * (int64_t)nblk + nval) return -2;
+  const uint8_t* mb = payload + nb8;
+  const int8_t* vals =
+      (const int8_t*)(payload + nb8 + 2 * (int64_t)nblk);
+  return sparse_unpack2_core(
+      nblk, nval, payload,
+      [mb](int32_t i) {
+        return (uint32_t)mb[2 * i] | ((uint32_t)mb[2 * i + 1] << 8);
+      },
+      vals, out, L);
 }
 
 // ---- P-slice support -------------------------------------------------------

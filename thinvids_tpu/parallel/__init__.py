@@ -5,9 +5,23 @@ to worker nodes over a task queue (/root/reference/worker/tasks.py:597-609,
 977-1052); here the timeline is sharded at closed-GOP boundaries across the
 devices of a `jax.sharding.Mesh` with `shard_map`, and encoded segments are
 re-assembled in index order (the stitcher analog, tasks.py:2047-2069).
+
+Imports are lazy: the process-based pack sidecars (packproc.py) live in
+this package but run in spawned children that must import it WITHOUT
+dragging dispatch's jax dependency in (initializing a device backend in
+every pack worker would be fatal on real hardware).
 """
 
-from .planner import plan_segments
-from .dispatch import GopShardEncoder, encode_clip_sharded
-
 __all__ = ["plan_segments", "GopShardEncoder", "encode_clip_sharded"]
+
+
+def __getattr__(name):
+    if name == "plan_segments":
+        from .planner import plan_segments
+
+        return plan_segments
+    if name in ("GopShardEncoder", "encode_clip_sharded"):
+        from . import dispatch
+
+        return getattr(dispatch, name)
+    raise AttributeError(name)

@@ -27,6 +27,21 @@ _FrameCursor window), and :func:`background_stage` runs the whole
 decode→stack→upload chain on a staging thread up to `decode_ahead`
 waves (TVT_DECODE_AHEAD) ahead of dispatch, overlapping source decode
 with device compute.
+
+The device→host boundary itself is compacted and parallelized three
+ways (BENCH r04→r05 showed every device-side win dying here):
+`compact_transfer` (TVT_COMPACT_TRANSFER, default on) adds a device
+stage that packs the two-tier sparse streams into ONE contiguous byte
+payload per GOP (jaxcore._compact_stream; format in codecs/h264/
+layout.py) so the bulk fetch moves `used` bytes instead of three
+budget-padded arrays; collect_wave fetches with one transfer thread
+per device shard so the ~0.1–0.2 s tunnel latency overlaps across the
+mesh instead of serializing; and `pack_backend=process`
+(TVT_PACK_BACKEND) opts into shared-memory pack sidecars (packproc.py)
+that run unpack+unflatten+pack outside this process's GIL. Every path
+is bit-identical to the original sparse2 transfer (parity-tested), and
+the old path stays live as the validated fallback (compact_transfer
+off, thread backend, dense wave fallback).
 """
 
 from __future__ import annotations
@@ -45,20 +60,22 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from collections import deque
 
-from ..core.config import get_settings
+from ..core.config import as_bool, get_settings
 from ..core.devices import shard_map
+from ..core.log import get_logging
 from ..core.types import (ChromaFormat, EncodedSegment, Frame, GopSpec,
                           SegmentPlan, VideoMeta)
 from ..codecs.h264 import jaxcore
 from ..codecs.h264.encoder import gop_slice_thunks_planes, pack_slice
 from ..codecs.h264.headers import PPS, SPS
-# Per-MB flat sizes, owned by jaxinter next to the layout they describe
-# (intra: luma_dc 16 + luma_ac 240 + chroma 128; P plane layout: luma
-# plane 256 + chroma DC 8 + chroma AC planes 128 — MVs ride separately
-# as int8).
-from ..codecs.h264.jaxinter import _INTRA_FLAT_MB as _INTRA_MB
-from ..codecs.h264.jaxinter import _P_FLAT_MB
+# Transfer-layout contract (jax-free module shared with the process
+# pack sidecars): per-MB flat sizes + the zero-copy host unflattens.
+from ..codecs.h264.layout import _INTRA_FLAT_MB as _INTRA_MB
+from ..codecs.h264.layout import (_P_FLAT_MB, unflatten_gop,
+                                  unflatten_gop_parts)
 from .planner import plan_segments
+
+_LOG = get_logging(__name__)
 
 
 def default_mesh(devices=None) -> Mesh:
@@ -70,9 +87,23 @@ def default_mesh(devices=None) -> Mesh:
 
 #: canonical stage keys, in pipeline order (decode = pulling frames
 #: from the ingest source; stage = stack + H2D upload — both run on
-#: the staging thread when background_stage wraps the generator)
+#: the staging thread when background_stage wraps the generator;
+#: dense_retry = the rare wave-wide dense re-encode + wide fetch when
+#: the sparse budgets overflow — split out of "fetch" so the fetch
+#: number answers only "what does the COMMON bulk transfer cost")
 STAGE_NAMES = ("decode", "stage", "dispatch", "device_wait", "fetch",
-               "sparse_unpack", "unflatten", "pack", "concat")
+               "dense_retry", "sparse_unpack", "unflatten", "pack",
+               "concat")
+
+#: monotonic counters riding in the same snapshot as the stage clocks:
+#: dense_fallback_waves (waves that overflowed the sparse budgets and
+#: re-encoded dense), d2h_bytes (actual device→host bytes fetched —
+#: bench derives d2h_bytes_per_frame from it), fetch_shards (per-shard
+#: concurrent fetch transfers issued; 0 means every fetch was a single
+#: blocking device_get), proc_pack_gops (GOPs handed to the
+#: pack_backend=process sidecars instead of the thread pool)
+STAGE_COUNTERS = ("dense_fallback_waves", "d2h_bytes", "fetch_shards",
+                  "proc_pack_gops")
 
 
 class StageProfile:
@@ -89,6 +120,7 @@ class StageProfile:
     def __init__(self, mirror: "StageProfile | None" = None) -> None:
         self._lock = threading.Lock()
         self._ms = {k: 0.0 for k in STAGE_NAMES}
+        self._counts = {k: 0 for k in STAGE_COUNTERS}
         self._waves = 0
         self._mirror = mirror
 
@@ -97,6 +129,13 @@ class StageProfile:
             self._ms[stage] = self._ms.get(stage, 0.0) + seconds * 1e3
         if self._mirror is not None:
             self._mirror.add(stage, seconds)
+
+    def bump(self, counter: str, n: int = 1) -> None:
+        """Increment a monotonic counter (STAGE_COUNTERS) by `n`."""
+        with self._lock:
+            self._counts[counter] = self._counts.get(counter, 0) + int(n)
+        if self._mirror is not None:
+            self._mirror.bump(counter, n)
 
     @contextlib.contextmanager
     def stage(self, name: str):
@@ -115,6 +154,7 @@ class StageProfile:
     def snapshot(self) -> dict:
         with self._lock:
             out = {k: round(v, 2) for k, v in self._ms.items()}
+            out.update(self._counts)
             out["waves"] = self._waves
             return out
 
@@ -122,6 +162,8 @@ class StageProfile:
         with self._lock:
             for k in self._ms:
                 self._ms[k] = 0.0
+            for k in self._counts:
+                self._counts[k] = 0
             self._waves = 0
 
 
@@ -270,7 +312,7 @@ def _flat_levels(y, u, v, qp, mbw, mbh):
         ldc.reshape(-1), lac.reshape(-1), cdc.reshape(-1), cac.reshape(-1)])
 
 
-def _per_gop_sparse(y, u, v, qp, mbw: int, mbh: int):
+def _per_gop_sparse(y, u, v, qp, mbw: int, mbh: int, compact: bool = False):
     """(F, H, W) GOP → (mv int8, dense intra-DC segments, two-tier
     sparse levels for the rest).
 
@@ -281,7 +323,12 @@ def _per_gop_sparse(y, u, v, qp, mbw: int, mbh: int):
     side-channel (its full-size scatters were ~60% of the pack's
     device time) — an escape anywhere forces the wave-wide dense
     fallback, so low-QP encodes would otherwise fall permanently into
-    the slow path (ADVICE round 5)."""
+    the slow path (ADVICE round 5).
+
+    With `compact` the three sparse streams additionally fold into one
+    contiguous byte payload on device (jaxcore._compact_stream), so the
+    output is (mv8, dense, nblk, nval, n_esc, used, payload) — 7 arrays
+    — instead of the 8-array (…, bitmap, bmask16, vals) layout."""
     from ..codecs.h264 import jaxinter
 
     mv8, flat = jaxinter.encode_gop_planes(y, u, v, qp, mbw=mbw, mbh=mbh)
@@ -289,7 +336,13 @@ def _per_gop_sparse(y, u, v, qp, mbw: int, mbh: int):
     ndc, nlac, ncdc = nmb * 16, nmb * 240, nmb * 8
     dense = jnp.concatenate([flat[:ndc], flat[ndc + nlac:ndc + nlac + ncdc]])
     rest = jnp.concatenate([flat[ndc:ndc + nlac], flat[ndc + nlac + ncdc:]])
-    return (mv8, dense) + jaxcore._block_sparse_pack2(rest)
+    nblk, nval, n_esc, bitmap, bmask16, vals = \
+        jaxcore._block_sparse_pack2(rest)
+    if not compact:
+        return (mv8, dense, nblk, nval, n_esc, bitmap, bmask16, vals)
+    used, payload = jaxcore._compact_stream(nblk, nval, bitmap, bmask16,
+                                            vals)
+    return (mv8, dense, nblk, nval, n_esc, used, payload)
 
 
 def _per_gop_dense(y, u, v, qp, mbw: int, mbh: int, dtype):
@@ -299,107 +352,48 @@ def _per_gop_dense(y, u, v, qp, mbw: int, mbh: int, dtype):
     return flat.astype(dtype)
 
 
-def _unflatten_intra(seg: np.ndarray, nmb: int):
-    """Flat intra segment (nmb * 384, layout il_dc|il_ac|ic_dc|ic_ac) →
-    blocked VIEWS. The int16 views feed cavlc_pack_islice16 directly —
-    the old astype(int32) chain here allocated ~4 copies of the intra
-    levels per GOP on the critical path."""
-    o = nmb * 16
-    il_dc = seg[:o].reshape(nmb, 16)
-    il_ac = seg[o:o + nmb * 240].reshape(nmb, 16, 15)
-    o += nmb * 240
-    ic_dc = seg[o:o + nmb * 8].reshape(nmb, 2, 4)
-    o += nmb * 8
-    ic_ac = seg[o:o + nmb * 120].reshape(nmb, 2, 4, 15)
-    return il_dc, il_ac, ic_dc, ic_ac
+# Zero-copy unflatten views (flat transfer segments → slice arrays) —
+# the implementations live in the jax-free layout module so the process
+# pack sidecars share them; these aliases keep this module's historical
+# names for callers and tests.
+_unflatten_gop = unflatten_gop
+_unflatten_gop_parts = unflatten_gop_parts
 
 
-def _unflatten_p_planes(seg: np.ndarray, mv8: np.ndarray, num_frames: int,
-                        mbw: int, mbh: int):
-    """Flat P segment → plane VIEWS (the plane->blocked scan happens
-    inside the native packer, cavlc_pack_pslice_plane, so no relayout
-    pass runs on the host)."""
-    nmb = mbw * mbh
-    H, W = mbh * 16, mbw * 16
-    hw2 = (H // 2) * (W // 2)
-    F1 = num_frames - 1
-    o = 0
-    lp = seg[o:o + F1 * H * W].reshape(F1, H, W)
-    o += F1 * H * W
-    udc = seg[o:o + F1 * nmb * 4].reshape(F1, nmb, 4)
-    o += F1 * nmb * 4
-    vdc = seg[o:o + F1 * nmb * 4].reshape(F1, nmb, 4)
-    o += F1 * nmb * 4
-    uac = seg[o:o + F1 * hw2].reshape(F1, H // 2, W // 2)
-    o += F1 * hw2
-    vac = seg[o:o + F1 * hw2].reshape(F1, H // 2, W // 2)
-    return (np.asarray(mv8), lp, udc, vdc, uac, vac)
-
-
-def _unflatten_gop(flat: np.ndarray, mv8: np.ndarray, num_frames: int,
-                   mbw: int, mbh: int):
-    """Host inverse of jaxinter.encode_gop_planes: split the flat int16
-    vector into (intra blocked arrays, P plane views). EVERY array is a
-    zero-copy view into `flat`."""
-    nmb = mbw * mbh
-    flat = np.asarray(flat)
-    o = nmb * _INTRA_MB
-    intra = _unflatten_intra(flat[:o], nmb)
-    planes = _unflatten_p_planes(flat[o:], mv8, num_frames, mbw, mbh)
-    return intra, planes
-
-
-def _unflatten_gop_parts(dense: np.ndarray, rest: np.ndarray,
-                         mv8: np.ndarray, num_frames: int,
-                         mbw: int, mbh: int):
-    """Sparse-path unflatten straight from the two transfer segments —
-    dense = [il_dc | ic_dc] (the hadamard DC prefix, _per_gop_sparse),
-    rest = [il_ac | ic_ac | P planes] — without first concatenating
-    them back into the full flat layout (which copied ~25 MB per 1080p
-    GOP). Views only."""
-    nmb = mbw * mbh
-    ndc, nlac = nmb * 16, nmb * 240
-    dense = np.asarray(dense)
-    rest = np.asarray(rest)
-    il_dc = dense[:ndc].reshape(nmb, 16)
-    ic_dc = dense[ndc:].reshape(nmb, 2, 4)
-    il_ac = rest[:nlac].reshape(nmb, 16, 15)
-    o = nlac + nmb * 120
-    ic_ac = rest[nlac:o].reshape(nmb, 2, 4, 15)
-    planes = _unflatten_p_planes(rest[o:], mv8, num_frames, mbw, mbh)
-    return (il_dc, il_ac, ic_dc, ic_ac), planes
-
-
-@functools.partial(jax.jit, static_argnames=("mbw", "mbh", "mesh"))
-def _encode_wave_gop(ys, us, vs, qps, *, mbw: int, mbh: int, mesh: Mesh):
+@functools.partial(jax.jit,
+                   static_argnames=("mbw", "mbh", "mesh", "compact"))
+def _encode_wave_gop(ys, us, vs, qps, *, mbw: int, mbh: int, mesh: Mesh,
+                     compact: bool = False):
     """ys: (G, F, H, W) uint8 sharded over `gop`, G = devices x k; each
     device sequentially encodes its k GOPs (IDR + P, jaxinter) at its
     per-GOP QP (qps: (G,) int32, the rate-control hook) and sparse-packs
-    the plane-layout levels."""
+    the plane-layout levels (`compact` folds the sparse streams into
+    one byte payload per GOP — see _per_gop_sparse)."""
 
     def per_dev(y_g, u_g, v_g, qp_g):
         def one(args):
             y, u, v, qp = args
-            return _per_gop_sparse(y, u, v, qp, mbw, mbh)
+            return _per_gop_sparse(y, u, v, qp, mbw, mbh, compact=compact)
         return jax.lax.map(one, (y_g, u_g, v_g, qp_g))
 
     shard = shard_map(
         per_dev, mesh=mesh,
         in_specs=(P("gop"),) * 4,
-        out_specs=(P("gop"),) * 8,
+        out_specs=(P("gop"),) * (7 if compact else 8),
     )
     return shard(ys, us, vs, qps)
 
 
-@functools.partial(jax.jit, static_argnames=("mbw", "mbh"))
-def _encode_gop_single(ys, us, vs, qps, *, mbw: int, mbh: int):
+@functools.partial(jax.jit, static_argnames=("mbw", "mbh", "compact"))
+def _encode_gop_single(ys, us, vs, qps, *, mbw: int, mbh: int,
+                       compact: bool = False):
     """Single-device wave: the same per-GOP program WITHOUT the
     shard_map wrapper. On one chip shard_map buys nothing and costs a
     lot — measured on TPU v5e: compile 33 s → 810 s and steady-state
     256 ms → 800 ms per 1080p GOP under the manual-axes lowering."""
     def one(args):
         y, u, v, qp = args
-        return _per_gop_sparse(y, u, v, qp, mbw, mbh)
+        return _per_gop_sparse(y, u, v, qp, mbw, mbh, compact=compact)
     return jax.lax.map(one, (ys, us, vs, qps))
 
 
@@ -494,7 +488,9 @@ class GopShardEncoder:
                  inter: bool = True, gops_per_wave: int = 4,
                  pack_workers: int | None = None,
                  pipeline_window: int | None = None,
-                 decode_ahead: int | None = None):
+                 decode_ahead: int | None = None,
+                 compact_transfer: bool | None = None,
+                 pack_backend: str | None = None):
         self.meta = meta
         self.qp = qp
         #: inter=True encodes each GOP as IDR + P frames (motion-coded);
@@ -529,6 +525,15 @@ class GopShardEncoder:
         if decode_ahead is None:
             decode_ahead = int(snap.get("decode_ahead", 0) or 0)
         self.decode_ahead = int(decode_ahead) or self.DECODE_AHEAD
+        #: device-side stream compaction (jaxcore._compact_stream): the
+        #: sparse GOP streams fold into one byte payload on device and
+        #: the host fetches only the used prefix. Default on; off keeps
+        #: the original three-array sparse2 transfer (the validated
+        #: fallback — bit-identical either way, parity-tested).
+        if compact_transfer is None:
+            compact_transfer = as_bool(snap.get("compact_transfer", True),
+                                       True)
+        self.compact_transfer = bool(compact_transfer)
         #: per-stage host wall-clock (bench `stage_ms`, /metrics_snapshot)
         self.stages = StageProfile(mirror=_TOTALS)
         #: streaming-ingest instrumentation: peak decoded frames the
@@ -537,6 +542,27 @@ class GopShardEncoder:
         #: eager so concurrent collect_wave threads never race a lazy
         #: init; the executor spawns NO threads until first submit
         self._pack_pool = self._new_pack_pool()
+        #: bulk-fetch transfer threads: one in-flight transfer per
+        #: device shard so the per-fetch link latency (~0.1–0.2 s over
+        #: an axon tunnel) overlaps across the mesh instead of
+        #: serializing. None on single-device meshes (nothing to
+        #: overlap — plain device_get).
+        self._fetch_pool = self._new_fetch_pool()
+        #: entropy-pack execution backend: "thread" (slice thunks on
+        #: the pack pool) or "process" (GOP-granular shared-memory
+        #: sidecars, packproc.py — unpack+pack outside this process's
+        #: GIL). Process packing rides the compact payload; waves that
+        #: fall off it (dense fallback, compact_transfer off, intra
+        #: path) pack on threads as before.
+        if pack_backend is None:
+            pack_backend = str(snap.get("pack_backend", "thread")
+                               or "thread")
+        self.pack_backend = str(pack_backend)
+        self._proc_pool = self._new_proc_pool()
+        #: one warning per encoder when async D2H prefetch is refused
+        #: (a platform where copy_to_host_async silently no-ops must be
+        #: visible in the logs, not swallowed)
+        self._async_copy_unavailable = False
         #: Optional per-GOP QP overrides (rate control): gop index → qp.
         #: GOPs absent from the map encode at the base `qp`; slice
         #: headers carry the delta vs PPS init_qp.
@@ -653,23 +679,39 @@ class GopShardEncoder:
             wave, ysd, usd, vsd, qpsd = staged
             ph, pw = ysd.shape[2], ysd.shape[3]
             mbh, mbw = ph // 16, pw // 16
+            compact = self.inter and self.compact_transfer
             if self.inter and self.num_devices == 1:
                 out = _encode_gop_single(ysd, usd, vsd, qpsd, mbw=mbw,
-                                         mbh=mbh)
+                                         mbh=mbh, compact=compact)
             elif self.inter:
                 out = _encode_wave_gop(ysd, usd, vsd, qpsd, mbw=mbw, mbh=mbh,
-                                       mesh=self.mesh)
+                                       mesh=self.mesh, compact=compact)
             else:
                 out = _encode_wave(ysd, usd, vsd, qpsd, mbw=mbw, mbh=mbh,
                                    mesh=self.mesh)
-            for arr in out:
-                # Start the device->host copies now, overlapped with the
-                # next wave's compute (the transfer link has high latency
-                # — axon tunnels measure ~0.1-0.2 s per blocking fetch).
-                try:
-                    arr.copy_to_host_async()
-                except Exception:   # noqa: BLE001 - best-effort prefetch
-                    pass
+            if not self._async_copy_unavailable:
+                for i, arr in enumerate(out):
+                    # Start the device->host copies now, overlapped with
+                    # the next wave's compute (the transfer link has high
+                    # latency — axon tunnels measure ~0.1-0.2 s per
+                    # blocking fetch). The compact payload (index 6) is
+                    # NOT prefetched: collect_wave fetches only its used
+                    # prefix, and an async copy would drag the whole
+                    # budget-padded buffer across the link anyway.
+                    if compact and i == 6:
+                        continue
+                    try:
+                        arr.copy_to_host_async()
+                    except Exception as exc:   # noqa: BLE001 - visible,
+                        # once per encoder: a platform where async D2H
+                        # no-ops must show up in the activity log, not
+                        # silently serialize every fetch.
+                        self._async_copy_unavailable = True
+                        _LOG.warning(
+                            "copy_to_host_async rejected (%s: %s); "
+                            "device→host prefetch disabled for this "
+                            "encoder", type(exc).__name__, exc)
+                        break
             return (wave, ysd, usd, vsd, qpsd, mbw, mbh, out)
 
     def _new_pack_pool(self):
@@ -688,43 +730,259 @@ class GopShardEncoder:
         weakref.finalize(self, pool.shutdown, False)
         return pool
 
+    def _new_fetch_pool(self):
+        """Per-shard D2H transfer threads (collect_wave), or None on a
+        single-device mesh. Two slots per device so the next wave's
+        shard fetches queue behind the current one's without a new
+        round of pool growth."""
+        if self.num_devices <= 1:
+            return None
+        import concurrent.futures as cf
+        import weakref
+
+        pool = cf.ThreadPoolExecutor(min(32, 2 * self.num_devices),
+                                     thread_name_prefix="tvt-fetch")
+        weakref.finalize(self, pool.shutdown, False)
+        return pool
+
+    def _new_proc_pool(self):
+        """GOP-granular pack sidecar processes (pack_backend=process),
+        or None for the threaded backend. Spawn context: children
+        import packproc fresh and must never inherit (or initialize) a
+        jax backend. Falls back to threads with a warning when the
+        platform can't spawn a pool."""
+        if self.pack_backend != "process" or not self.inter:
+            return None
+        import concurrent.futures as cf
+        import multiprocessing as mp
+        import weakref
+
+        try:
+            pool = cf.ProcessPoolExecutor(
+                max(1, min(self.pack_workers, 8)),
+                mp_context=mp.get_context("spawn"))
+        except Exception as exc:    # noqa: BLE001 - degrade, don't die
+            _LOG.warning("pack_backend=process unavailable (%s: %s); "
+                         "falling back to threaded pack",
+                         type(exc).__name__, exc)
+            return None
+        weakref.finalize(self, pool.shutdown, False)
+        return pool
+
     def _slice_pool(self):
         return self._pack_pool
 
+    #: payload fetch slice quantum cap (bytes): used prefixes round up
+    #: to a quantum of max(256, min(this, PB // 8)) so the device-side
+    #: slice shapes repeat across waves (each distinct shape
+    #: jit-compiles once) instead of recompiling per wave — the
+    #: PB // 8 term keeps the rounding proportional at small payloads,
+    #: the cap bounds the over-fetch at < 64 KB per GOP at 4K scale.
+    PAYLOAD_QUANTUM = 1 << 16
+
+    def _fetch_bulk(self, arrays) -> list[np.ndarray]:
+        """Bulk device→host fetch: one transfer per device shard, all
+        shards of all arrays in flight at once on the fetch pool, so
+        the per-transfer link latency (~0.1–0.2 s over an axon tunnel)
+        overlaps across the mesh — an 8-chip wave fetches in ~1 tunnel
+        latency instead of 8. Plain blocking device_get on
+        single-device meshes (nothing to overlap)."""
+        arrays = list(arrays)
+        pool = self._fetch_pool
+        if pool is None:
+            host = jax.device_get(arrays)
+            self.stages.bump("d2h_bytes",
+                             sum(int(a.nbytes) for a in host))
+            return host
+        futss = []
+        for arr in arrays:
+            shards = sorted(arr.addressable_shards,
+                            key=lambda s: s.index[0].start or 0)
+            self.stages.bump("fetch_shards", len(shards))
+            futss.append([pool.submit(np.asarray, s.data)
+                          for s in shards])
+        host = []
+        for futs in futss:
+            parts = [f.result() for f in futs]
+            a = parts[0] if len(parts) == 1 else np.concatenate(parts)
+            self.stages.bump("d2h_bytes", int(a.nbytes))
+            host.append(a)
+        return host
+
+    def _fetch_payload_rows(self, payload, used) -> list[np.ndarray]:
+        """Fetch the wave's compact payloads SLICED to their used
+        prefix: each shard moves max(used) bytes per GOP (rounded up to
+        PAYLOAD_QUANTUM) instead of the whole budget-padded buffer, one
+        transfer thread per device shard. Returns a 1-D uint8 row per
+        GOP (row length >= that GOP's used bytes)."""
+        used = np.asarray(used)
+        G, PB = payload.shape
+        q = max(256, min(self.PAYLOAD_QUANTUM, PB // 8))
+
+        def cut(n) -> int:
+            return min(PB, -(-max(int(n), 1) // q) * q)
+
+        pool = self._fetch_pool
+        if pool is None:
+            host = np.asarray(payload[:, :cut(used.max())])
+            self.stages.bump("d2h_bytes", int(host.nbytes))
+            return list(host)
+        shards = sorted(payload.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        self.stages.bump("fetch_shards", len(shards))
+        futs = []
+        for s in shards:
+            a = s.index[0].start or 0
+            mu = cut(used[a:a + s.data.shape[0]].max())
+            futs.append((a, pool.submit(
+                lambda d=s.data, m=mu: np.asarray(d[:, :m]))))
+        rows: list = [None] * G
+        for a, f in futs:
+            part = f.result()
+            self.stages.bump("d2h_bytes", int(part.nbytes))
+            for i in range(part.shape[0]):
+                rows[a + i] = part[i]
+        return rows
+
+    @staticmethod
+    def _unpack_compact(payload_row: np.ndarray, nblk: int, nval: int,
+                        used: int, L: int) -> np.ndarray:
+        """Compact payload's used prefix → flat int16 levels (the
+        native-or-numpy dispatch lives with the format contract,
+        layout.unpack_compact_auto — shared with the pack sidecars)."""
+        from ..codecs.h264.layout import unpack_compact_auto
+
+        return unpack_compact_auto(payload_row[:used], nblk, nval, L)
+
+    @staticmethod
+    def _release_spool(shm, spools: list) -> None:
+        if shm in spools:
+            spools.remove(shm)
+        shm.close()
+        try:
+            shm.unlink()
+        except FileNotFoundError:       # pragma: no cover
+            pass
+
+    def _disable_proc_pool(self, exc: BaseException) -> None:
+        """Runtime degrade: a broken sidecar pool (spawn refused, child
+        OOM-killed) must not fail the encode — retire the pool and pack
+        the rest of the job on threads."""
+        if self._proc_pool is not None:
+            self._proc_pool = None
+            _LOG.warning(
+                "pack sidecar pool broke (%s: %s); packing on threads "
+                "from here on", type(exc).__name__, exc)
+
+    def _submit_process_pack(self, proc, mv8_g, dc16_g, payload_row,
+                             nblk: int, nval: int, used: int,
+                             gop: GopSpec, F: int, mbw: int, mbh: int,
+                             gop_qp: int, spools: list):
+        """Spool one GOP's compact transfer parts ([mv8 | dense DC |
+        payload]) into a shared-memory block and submit its
+        unpack+unflatten+pack to the sidecar pool (packproc). Returns a
+        callable yielding the slice payloads; it releases the spool
+        after the result lands (`spools` lets collect_wave release
+        blocks whose gather was never reached when a wave fails
+        mid-flight). A BROKEN pool degrades instead of failing the
+        wave: the same spool bytes pack in-process via packproc."""
+        import dataclasses as _dc
+        from concurrent.futures.process import BrokenProcessPool
+        from multiprocessing import shared_memory
+
+        from . import packproc
+
+        mv = np.ascontiguousarray(mv8_g).view(np.uint8).reshape(-1)
+        dn = np.ascontiguousarray(dc16_g).view(np.uint8).reshape(-1)
+        pl = np.ascontiguousarray(payload_row[:used])
+        total = mv.nbytes + dn.nbytes + pl.nbytes
+        shm = shared_memory.SharedMemory(create=True, size=max(1, total))
+        spools.append(shm)
+        buf = np.frombuffer(shm.buf, np.uint8)
+        buf[:mv.nbytes] = mv
+        buf[mv.nbytes:mv.nbytes + dn.nbytes] = dn
+        buf[mv.nbytes + dn.nbytes:total] = pl
+        del buf     # shm.close() refuses while exported views exist
+        args = (shm.name, mv.nbytes, dn.nbytes, pl.nbytes, nblk, nval,
+                gop.num_frames, F, mbw, mbh, _dc.asdict(self.sps),
+                _dc.asdict(self.pps), gop_qp, gop.index)
+        try:
+            fut = proc.submit(packproc.pack_gop_from_shm, *args)
+        except Exception:
+            self._release_spool(shm, spools)
+            raise
+        self.stages.bump("proc_pack_gops")
+
+        def gather() -> list[bytes]:
+            try:
+                return fut.result()
+            except BrokenProcessPool as exc:
+                self._disable_proc_pool(exc)
+                # the spool holds everything the child would have read
+                return packproc.pack_gop_from_shm(*args)
+            finally:
+                self._release_spool(shm, spools)
+
+        return gather
+
     def collect_wave(self, pending: tuple) -> list[EncodedSegment]:
-        """Fetch one dispatched wave's levels (sparse, with the dense
-        fallback) and entropy-pack its GOPs on host, fanning the pack
-        across the slice pool (all of the wave's slices at once)."""
+        """Fetch one dispatched wave's levels (compact or sparse, with
+        the dense fallback) and entropy-pack its GOPs on host, fanning
+        the pack across the slice pool — or, with pack_backend=process,
+        handing whole GOPs to the shared-memory sidecars."""
         wave, ysd, usd, vsd, qpsd, mbw, mbh, out = pending
         prof = self.stages
         F = ysd.shape[1]
         nmb = mbw * mbh
         L = (nmb * _INTRA_MB + (F - 1) * nmb * _P_FLAT_MB if self.inter
              else nmb * _INTRA_MB)
-        # Barrier on a tiny count output first: it completes when the
-        # wave's compute does, splitting "waiting on the device" from
-        # the bulk D2H fetch in the stage breakdown.
+        compact = self.inter and self.compact_transfer
+        # Barrier on the tiny count outputs first: they complete when
+        # the wave's compute does, splitting "waiting on the device"
+        # from the bulk D2H fetch in the stage breakdown — and letting
+        # a budget overflow skip the bulk sparse fetch entirely.
         t0 = time.perf_counter()
-        _ = jax.device_get(out[2] if self.inter else out[0])
-        prof.add("device_wait", time.perf_counter() - t0)
-        flat = None
         if self.inter:
-            with prof.stage("fetch"):
-                (mv8, dc16, nblk, nval, n_esc, bitmap, bmask16,
-                 vals) = jax.device_get(out)
+            tiny = jax.device_get(list(out[2:6] if compact else out[2:5]))
+        else:
+            tiny = jax.device_get([out[0], out[1]])
+        prof.add("device_wait", time.perf_counter() - t0)
+        prof.bump("d2h_bytes", sum(int(a.nbytes) for a in tiny))
+        flat = None
+        used = payload_rows = None
+        if self.inter:
+            nblk, nval, n_esc = tiny[0], tiny[1], tiny[2]
             # dense prefix = both intra hadamard DC segments (luma +
             # chroma); the sparse remainder skips them (_per_gop_sparse)
             ndc, ncdc = nmb * 16, nmb * 8
             Lr = L - ndc - ncdc
             sparse_ok = jaxcore.block_sparse2_fits(
                 nblk.max(), nval.max(), n_esc.max(), Lr)
+            if sparse_ok:
+                with prof.stage("fetch"):
+                    if compact:
+                        used = tiny[3]
+                        mv8, dc16 = self._fetch_bulk(out[0:2])
+                        payload_rows = self._fetch_payload_rows(out[6],
+                                                                used)
+                    else:
+                        mv8, dc16, bitmap, bmask16, vals = \
+                            self._fetch_bulk(
+                                (out[0], out[1], out[5], out[6], out[7]))
         else:
-            with prof.stage("fetch"):
-                nnz, n_esc, bitmap, vals, esc_pos, esc_val = \
-                    jax.device_get(out)
+            nnz, n_esc = tiny
             sparse_ok = jaxcore.sparse_fits(nnz.max(), n_esc.max(), L)
+            if sparse_ok:
+                with prof.stage("fetch"):
+                    bitmap, vals, esc_pos, esc_val = \
+                        self._fetch_bulk(out[2:6])
         if not sparse_ok:
-            with prof.stage("fetch"):
+            # Rare wave-wide dense retry: re-encode + wide int16 fetch.
+            # Its own stage (not "fetch") so the fetch number answers
+            # only "what does the common bulk transfer cost", plus a
+            # counter so overflow-prone content is visible in metrics.
+            prof.bump("dense_fallback_waves")
+            with prof.stage("dense_retry"):
                 if self.inter and self.num_devices == 1:
                     flat = jax.device_get(_encode_gop_single_dense(
                         ysd, usd, vsd, qpsd, mbw=mbw, mbh=mbh,
@@ -737,6 +995,11 @@ class GopShardEncoder:
                     flat = jax.device_get(_encode_wave_dense(
                         ysd, usd, vsd, qpsd, mbw=mbw, mbh=mbh,
                         mesh=self.mesh, dtype=jnp.int16))
+                prof.bump("d2h_bytes", int(flat.nbytes))
+                if self.inter:
+                    # the dense program re-emits levels only; MVs still
+                    # come from the already-computed sparse outputs
+                    (mv8,) = self._fetch_bulk(out[0:1])
         # Header QP must match what the device QUANTIZED with — read it
         # from the staged per-wave array, not the live gop_qp dict (a
         # caller mutating gop_qp between passes must not desync slices
@@ -749,25 +1012,42 @@ class GopShardEncoder:
                                 start_frame=(g.start_frame
                                              + self.frame_offset))
                     for g in wave]
-        # Phase 1: unpack levels and SUBMIT every GOP's slice thunks, so
-        # the pool packs the whole wave's slices concurrently; phase 2
-        # gathers in GOP order.
+        # Phase 1: unpack levels and SUBMIT every GOP's pack work — the
+        # slice pool packs the whole wave's slices concurrently (or the
+        # process sidecars take whole GOPs); phase 2 gathers in GOP
+        # order.
         pool = self._slice_pool()
+        proc = self._proc_pool if (compact and sparse_ok) else None
+        #: live shared-memory spools of this wave's process-pack jobs —
+        #: released by each gather(), and swept below if the wave dies
+        #: before every gather ran (a leaked block outlives the process)
+        spools: list = []
         jobs: list[tuple] = []
         for gi, gop in enumerate(wave):
             gop_qp = int(qps_host[gi])
             if self.inter:
+                if proc is not None:
+                    jobs.append((gop, self._submit_process_pack(
+                        proc, mv8[gi], dc16[gi], payload_rows[gi],
+                        int(nblk[gi]), int(nval[gi]), int(used[gi]),
+                        gop, F, mbw, mbh, gop_qp, spools)))
+                    continue
                 if sparse_ok:
                     with prof.stage("sparse_unpack"):
-                        rest = _sparse_unpack2_host(
-                            int(nblk[gi]), int(nval[gi]), bitmap[gi],
-                            bmask16[gi], vals[gi], Lr)
+                        if compact:
+                            rest = self._unpack_compact(
+                                payload_rows[gi], int(nblk[gi]),
+                                int(nval[gi]), int(used[gi]), Lr)
+                        else:
+                            rest = _sparse_unpack2_host(
+                                int(nblk[gi]), int(nval[gi]), bitmap[gi],
+                                bmask16[gi], vals[gi], Lr)
                     with prof.stage("unflatten"):
-                        intra, planes = _unflatten_gop_parts(
+                        intra, planes = unflatten_gop_parts(
                             dc16[gi], rest, mv8[gi], F, mbw, mbh)
                 else:
                     with prof.stage("unflatten"):
-                        intra, planes = _unflatten_gop(
+                        intra, planes = unflatten_gop(
                             flat[gi], mv8[gi], F, mbw, mbh)
                 # gop.num_frames (not F) drops the wave's tail-repeat
                 # padding.
@@ -789,19 +1069,25 @@ class GopShardEncoder:
                         self._pack_intra_frame, raw, mbw, mbh, gop, fi,
                         gop_qp))
             if pool is None:
-                jobs.append((gop, thunks, None))
+                jobs.append(
+                    (gop, lambda ts=thunks: [t() for t in ts]))
             else:
-                jobs.append((gop, None, [pool.submit(t) for t in thunks]))
+                futs = [pool.submit(t) for t in thunks]
+                jobs.append(
+                    (gop, lambda fs=futs: [f.result() for f in fs]))
         segments: list[EncodedSegment] = []
-        for gop, thunks, futs in jobs:
-            with prof.stage("pack"):
-                payload = ([t() for t in thunks] if futs is None
-                           else [f.result() for f in futs])
-            with prof.stage("concat"):
-                seg = EncodedSegment(
-                    gop=gop, payload=b"".join(payload),
-                    frame_sizes=tuple(len(p) for p in payload))
-            segments.append(seg)
+        try:
+            for gop, gather in jobs:
+                with prof.stage("pack"):
+                    payload = gather()
+                with prof.stage("concat"):
+                    seg = EncodedSegment(
+                        gop=gop, payload=b"".join(payload),
+                        frame_sizes=tuple(len(p) for p in payload))
+                segments.append(seg)
+        finally:
+            for shm in list(spools):    # gathers that never ran
+                self._release_spool(shm, spools)
         prof.count_wave()
         return segments
 
