@@ -191,8 +191,64 @@ def _run_cold(w: int, h: int, nframes: int, qp: int, gop_frames: int,
         os.unlink(path)
 
 
+def _run_ladder(w: int, h: int, nframes: int, qp: int, gop_frames: int,
+                rungs_spec: str = "1080,720,480,360",
+                runs: int = 3) -> dict:
+    """ABR-ladder throughput: one staged wave stream fanned across the
+    rung set (lower rungs derived on device — abr/scale.py), measured
+    as AGGREGATE frames·rungs per second, plus per-rung bits/frame.
+    Decode + H2D is shared across rungs, so the aggregate should beat
+    rungs × the single-rendition cost; `h2d_bytes` rides along as the
+    once-per-wave upload proof."""
+    import jax
+
+    from thinvids_tpu.abr.ladder import LadderShardEncoder, plan_ladder
+    from thinvids_tpu.core.config import DEFAULT_SETTINGS, Settings
+    from thinvids_tpu.core.types import VideoMeta
+
+    frames = make_frames(nframes, w, h)
+    meta = VideoMeta(width=w, height=h, fps_num=30, fps_den=1,
+                     num_frames=nframes)
+    snap = Settings(values=dict(DEFAULT_SETTINGS, qp=qp,
+                                ladder_rungs=rungs_spec))
+    rungs = plan_ladder(meta, snap)
+    enc = LadderShardEncoder(meta, rungs, gop_frames=gop_frames)
+    _, waves = enc._stager.prepare_waves(frames)
+    jax.block_until_ready([wv[1:] for wv in waves])
+
+    def encode_staged(wvs):
+        bundles = []
+        for wv in wvs:                  # depth-1: the figure is about
+            bundles.extend(             # rung fan-out, not pipelining
+                enc.collect_wave(enc.dispatch_wave(wv)))
+        return bundles
+
+    distinct = {}
+    for wv in waves:
+        distinct.setdefault(wv[1].shape, wv)
+    encode_staged(list(distinct.values()))      # warmup/compile
+
+    t_best = float("inf")
+    bundles = []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        out = encode_staged(waves)
+        t = time.perf_counter() - t0
+        if t < t_best:
+            t_best, bundles = t, out
+    rung_bits = {}
+    for rung in rungs:
+        total = sum(len(b.renditions[rung.name].payload) for b in bundles)
+        rung_bits[rung.name] = round(total * 8 / nframes)
+    return {"fps": nframes * len(rungs) / t_best,
+            "rungs": len(rungs),
+            "rung_bits_per_frame": rung_bits,
+            "h2d_bytes": enc.stages.snapshot().get("h2d_bytes", 0)}
+
+
 def build_result(r1080: dict, r4k: dict, *, platform: str, qp: int,
-                 gop: int, n_1080: int, cold: dict | None = None) -> dict:
+                 gop: int, n_1080: int, cold: dict | None = None,
+                 ladder: dict | None = None) -> dict:
     """Assemble the one-line BENCH JSON from the two resolutions' runs
     (kept separate from main() so tests can assert the schema — e.g.
     the `stage_ms` breakdown and the `fps_cold_1080p` cold figure — on
@@ -223,6 +279,12 @@ def build_result(r1080: dict, r4k: dict, *, platform: str, qp: int,
     if cold is not None:
         out["fps_cold_1080p"] = round(cold["fps"], 2)
         out["stage_ms_cold"] = cold["stage_ms"]
+    if ladder is not None:
+        # aggregate frames·rungs/s for the ABR ladder (one decode +
+        # upload shared across all rungs) + per-rung bits/frame
+        out["ladder_fps_1080p"] = round(ladder["fps"], 2)
+        out["ladder_rungs"] = ladder["rungs"]
+        out["ladder_bits_per_frame"] = ladder["rung_bits_per_frame"]
     return out
 
 
@@ -242,13 +304,18 @@ def main() -> None:
     # wave-shape compiles are already warm from the resident run.
     r_cold = _run_cold(1920, 1080, n_1080, qp, gop)
 
+    # ABR ladder: the 4-rung production workload (1080/720/480/360)
+    # over the same 1080p content, aggregate frames·rungs/s.
+    r_ladder = _run_ladder(1920, 1080, n_1080, qp, gop)
+
     # 4K rides with quality ON (psnr_y_2160p/ssim_y_2160p): 16 frames
     # keeps the untimed oracle decode affordable.
     n_4k = 16
     r4k = _run_pipeline(3840, 2160, n_4k, qp, gop, quality=True)
 
     print(json.dumps(build_result(r1080, r4k, platform=platform, qp=qp,
-                                  gop=gop, n_1080=n_1080, cold=r_cold)))
+                                  gop=gop, n_1080=n_1080, cold=r_cold,
+                                  ladder=r_ladder)))
 
 
 if __name__ == "__main__":

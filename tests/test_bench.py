@@ -48,8 +48,12 @@ def test_bench_result_schema_includes_stage_ms():
            "stage_ms": {}, "quality": {"psnr_y": 41.0, "ssim_y": 0.98}}
     cold = {"fps": 31.1, "bytes": 1200,
             "stage_ms": {k: 1.0 for k in STAGE_NAMES} | {"waves": 2}}
+    ladder = {"fps": 101.3, "rungs": 4,
+              "rung_bits_per_frame": {"1080p": 9000, "720p": 5000,
+                                      "480p": 2500, "360p": 1500},
+              "h2d_bytes": 123456}
     result = bench.build_result(r, r4k, platform="cpu", qp=27, gop=8,
-                                n_1080=64, cold=cold)
+                                n_1080=64, cold=cold, ladder=ladder)
     assert result["value"] == 33.3
     assert result["fps_2160p"] == 2.8
     assert set(STAGE_NAMES) <= set(result["stage_ms"])
@@ -71,3 +75,30 @@ def test_bench_result_schema_includes_stage_ms():
     assert result["psnr_y_2160p"] == 41.0
     assert result["ssim_y_2160p"] == 0.98
     assert result["psnr_y"] == 40.1
+    # ABR ladder figure: aggregate frames·rungs/s + per-rung bits/frame
+    assert result["ladder_fps_1080p"] == 101.3
+    assert result["ladder_rungs"] == 4
+    assert result["ladder_bits_per_frame"]["360p"] == 1500
+
+
+def test_run_ladder_reports_aggregate_and_shared_upload():
+    """The ladder bench fans one staged wave stream across rungs:
+    aggregate fps counts frames x rungs, per-rung bits ride along, and
+    h2d_bytes proves upload didn't scale with the rung count."""
+    r = bench._run_ladder(64, 48, nframes=4, qp=27, gop_frames=2,
+                          rungs_spec="24", runs=1)
+    assert r["rungs"] == 2                     # 48p (source) + 24p
+    assert r["fps"] > 0
+    assert set(r["rung_bits_per_frame"]) == {"48p", "24p"}
+    assert all(v > 0 for v in r["rung_bits_per_frame"].values())
+    # the single-rendition encoder uploads the same bytes for the same
+    # clip — the ladder's extra rung derived on device, not re-uploaded
+    from thinvids_tpu.core.types import VideoMeta
+    from thinvids_tpu.parallel.dispatch import GopShardEncoder
+
+    meta = VideoMeta(width=64, height=48, fps_num=30, fps_den=1,
+                     num_frames=4)
+    single = GopShardEncoder(meta, qp=27, gop_frames=2)
+    single.prepare_waves(bench.make_frames(4, 64, 48))
+    assert r["h2d_bytes"] == \
+        single.stages.snapshot()["h2d_bytes"] > 0

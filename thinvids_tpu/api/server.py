@@ -246,6 +246,7 @@ class ApiServer:
         ("POST", r"^/settings$", "post_settings"),
         ("GET", r"^/browse/list$", "browse_list"),
         ("GET", r"^/preview/(?P<job_id>[\w-]+)$", "preview"),
+        ("GET", r"^/hls/(?P<job_id>[\w-]+)/(?P<rel>.+)$", "hls"),
         ("POST", r"^/stamp_job/(?P<job_id>[\w-]+)$", "stamp_job"),
     ]
 
@@ -312,9 +313,13 @@ class ApiServer:
             meta = probe_video(input_path)
         except ProbeError as exc:
             raise ApiError(422, str(exc))
+        job_type = body.get("job_type")
+        if job_type is not None and job_type not in ("transcode",
+                                                     "ladder"):
+            raise ApiError(400, f"unknown job_type {job_type!r}")
         job = self.coordinator.add_job(
             input_path, meta, settings=body.get("settings"),
-            auto_start=body.get("auto_start"))
+            auto_start=body.get("auto_start"), job_type=job_type)
         return 201, _job_view(job)
 
     def _h_start_job(self, query, body, job_id) -> tuple[int, Any]:
@@ -460,9 +465,43 @@ class ApiServer:
     def _h_preview(self, query, body, job_id) -> tuple[int, Any]:
         """Stream a DONE job's output file (reference /preview/<id>)."""
         job = self._get_job(job_id)
+        if job.job_type == "ladder":
+            # a ladder's output_path is a playlist, not a previewable
+            # MP4 — labelling it video/mp4 would hand players garbage
+            raise ApiError(
+                409, f"ladder job: tune to /hls/{job_id}/master.m3u8")
         if not job.output_path or not os.path.exists(job.output_path):
             raise ApiError(404, "job has no output file")
         return 200, _FileResponse(job.output_path, "video/mp4")
+
+    #: content types the HLS route serves, by extension
+    _HLS_TYPES = {
+        ".m3u8": "application/vnd.apple.mpegurl",
+        ".mp4": "video/mp4",
+        ".m4s": "video/iso.segment",
+    }
+
+    def _h_hls(self, query, body, job_id, rel) -> tuple[int, Any]:
+        """Serve a DONE ladder job's HLS tree: master/media playlists,
+        init segments, and fMP4 fragments — `/hls/<job>/master.m3u8`
+        is what a player tunes to, and the playlists' relative URIs
+        resolve naturally under the same prefix. Traversal-safe within
+        the job's packaged output directory."""
+        job = self._get_job(job_id)
+        if job.job_type != "ladder":
+            raise ApiError(404, f"job {job_id} is not a ladder job")
+        if not job.output_path or not os.path.exists(job.output_path):
+            raise ApiError(404, "job has no packaged HLS output")
+        root = os.path.realpath(os.path.dirname(job.output_path))
+        target = os.path.realpath(os.path.join(root, rel))
+        if target != root and not target.startswith(root + os.sep):
+            raise ApiError(400, "path escapes the HLS root")
+        ctype = self._HLS_TYPES.get(os.path.splitext(target)[1].lower())
+        if ctype is None:
+            raise ApiError(404, f"not an HLS resource: {rel}")
+        if not os.path.isfile(target):
+            raise ApiError(404, f"no such HLS file {rel!r}")
+        return 200, _FileResponse(target, ctype)
 
     def _h_stamp_job(self, query, body, job_id) -> tuple[int, Any]:
         """Create a frame-index-watermarked copy of the job's source and
@@ -513,8 +552,11 @@ class ApiServer:
                 existing = next((j for j in co.store.list()
                                  if j.input_path == out), None)
                 if existing is None:
+                    # stamped copies are verification artifacts: always
+                    # single-rendition, even when the source job was a
+                    # ladder (the read-back flow expects one MP4)
                     co.add_job(out, meta=probe_video(out),
-                               auto_start=False)
+                               auto_start=False, job_type="transcode")
                     co.activity.emit("stamp", f"stamped copy at {out}",
                                      job_id=job_id)
                 else:

@@ -76,18 +76,14 @@ def run_coordinator(args: argparse.Namespace) -> None:
     # gate failed once would sit queued forever
     co.start_background()
 
-    # Local agent: the coordinator host reports its own health, and its
-    # accelerator devices register as encode slots — on a TPU host the
-    # devices are the "workers" the scheduler gates on (the reference
-    # gated on live thin-client nodes, app.py:1088-1133).
-    host_submit = coordinator_submitter(co)
-
-    def submit(host: str, metrics) -> None:
-        host_submit(host, metrics)
-        for i in range(int(metrics.get("devices", 0) or 0)):
-            co.registry.heartbeat(f"{host}-dev{i}")
-
-    agent = NodeAgent(submit, idle_probe=co.store.all_idle).start()
+    # Local agent: the coordinator host reports its own health AND its
+    # accelerator device count in ONE registry row — the scheduler
+    # weights the node by `metrics["devices"]` when gating capacity
+    # (Coordinator._worker_slots). It used to heartbeat a phantom
+    # `{host}-devN` pseudo-node per device, which gamed slot-capacity
+    # admission and polluted the nodes panel (VERDICT Weak #7).
+    agent = NodeAgent(coordinator_submitter(co),
+                      idle_probe=co.store.all_idle).start()
 
     stop = threading.Event()
     watcher_thread = None

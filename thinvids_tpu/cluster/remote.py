@@ -132,11 +132,18 @@ class Shard:
     id: str
     job_id: str
     input_path: str
-    meta: VideoMeta
+    meta: VideoMeta                 # SOURCE meta (what the worker decodes)
     gops: tuple[GopSpec, ...]       # GLOBAL indices / frame ranges
     qp: int
     gop_frames: int
     timeout_s: float
+    # ABR ladder (abr/ladder.py): which rendition this shard encodes;
+    # empty = plain single-rendition shard. Scaled rungs carry their
+    # target dims — the worker derives them on ITS device mesh from the
+    # source-resolution frames it decodes anyway.
+    rung: str = ""
+    rung_width: int = 0
+    rung_height: int = 0
     state: ShardState = ShardState.PENDING
     attempt: int = 0                # completed (failed) attempts so far
     not_before: float = 0.0         # backoff gate for re-claims
@@ -163,7 +170,7 @@ class Shard:
         idr_pic_id) are globally consistent — the same continuation
         mechanism the elastic replan uses (cluster/executor.py)."""
         g0, f0 = self.gops[0].index, self.gops[0].start_frame
-        return {
+        desc = {
             "id": self.id,
             "job_id": self.job_id,
             "input_path": self.input_path,
@@ -178,6 +185,10 @@ class Shard:
             "attempt": self.attempt,
             "timeout_s": self.timeout_s,
         }
+        if self.rung:
+            desc["rung"] = {"name": self.rung, "width": self.rung_width,
+                            "height": self.rung_height}
+        return desc
 
 
 @dataclasses.dataclass
@@ -257,12 +268,13 @@ class ShardBoard:
             return (done, total, entry.retried_parts, entry.failed_reason,
                     entry.failed_host)
 
-    def take_segments(self, job_id: str,
-                      token: str | None = None) -> list[EncodedSegment]:
-        """Collect a fully-DONE job's segments and drop its board state.
-        Token-fenced like cancel_job: a stale run must not pop the
-        entry a restarted run installed. Raises HaltedError when fenced
-        out, RuntimeError if any shard is not DONE (caller raced)."""
+    def take_shards(self, job_id: str,
+                    token: str | None = None) -> list[Shard]:
+        """Collect a fully-DONE job's shard records (segments + rung
+        tags) and drop its board state. Token-fenced like cancel_job: a
+        stale run must not pop the entry a restarted run installed.
+        Raises HaltedError when fenced out, RuntimeError if any shard
+        is not DONE (caller raced)."""
         with self._lock:
             entry = self._jobs.get(job_id)
             if entry is None or (token is not None
@@ -273,14 +285,19 @@ class ShardBoard:
             del self._jobs[job_id]
             self._order = [sid for sid in self._order
                            if sid not in entry.shards]
-            segments: list[EncodedSegment] = []
             for shard in entry.shards.values():
                 if shard.state is not ShardState.DONE:
                     raise RuntimeError(
                         f"collected shard {shard.id} in state "
                         f"{shard.state.value}")
-                segments.extend(shard.segments)
-            return segments
+            return list(entry.shards.values())
+
+    def take_segments(self, job_id: str,
+                      token: str | None = None) -> list[EncodedSegment]:
+        """Flattened-segment view of :meth:`take_shards` (the
+        single-rendition path)."""
+        return [seg for shard in self.take_shards(job_id, token=token)
+                for seg in shard.segments]
 
     # -- worker-facing API (via api/server.py /work/* routes) ----------
 
@@ -538,15 +555,22 @@ class RemoteExecutor(LocalExecutor):
         active = reg.active(float(snap.metrics_ttl_s), now=self._clock())
         return [w for w in active if w.metrics.get("worker")]
 
-    def _build_shards(self, job: Job, meta, num_frames: int,
-                      settings) -> tuple[SegmentPlan, list[Shard]]:
+    def _plan_remote(self, num_frames: int, settings) -> SegmentPlan:
         from ..parallel.planner import plan_segments
 
         workers = self._live_workers()
         plan_devices = int(settings.get("remote_plan_devices", 0)) \
             or max(1, len(workers))
-        plan = plan_segments(num_frames, int(settings.gop_frames),
+        return plan_segments(num_frames, int(settings.gop_frames),
                              plan_devices, int(settings.max_segments))
+
+    def _shards_for(self, job: Job, meta, plan: SegmentPlan, settings,
+                    qp: int, rung=None) -> list[Shard]:
+        """Cut one GOP plan into leased shards. With `rung` set
+        (abr.ladder.Rung) the shards are tagged for that rendition —
+        same GOP ranges as every other rung, so the rendition set stays
+        boundary-aligned no matter which workers encode which rungs."""
+        workers = self._live_workers()
         per_shard = int(settings.get("remote_shard_gops", 0))
         if per_shard <= 0:
             # auto: ~2 shards per worker so a straggler can rebalance
@@ -554,19 +578,29 @@ class RemoteExecutor(LocalExecutor):
                                  // max(1, 2 * max(1, len(workers)))))
         shards = []
         base_timeout = float(settings.remote_shard_timeout_s)
+        tag = f"{rung.name}-" if rung is not None else ""
         for i in range(0, plan.num_gops, per_shard):
             gops = plan.gops[i:i + per_shard]
             shards.append(Shard(
-                id=f"{job.id[:12]}-{gops[0].index:04d}",
+                id=f"{job.id[:12]}-{tag}{gops[0].index:04d}",
                 job_id=job.id, input_path=job.input_path, meta=meta,
-                gops=tuple(gops), qp=int(settings.qp),
+                gops=tuple(gops), qp=int(qp),
                 gop_frames=int(settings.gop_frames),
                 # lease scales with shard size: a 100-GOP shard must
                 # not be failure-counted on a single-GOP budget (dead
                 # workers are swept by heartbeat TTL long before any
                 # lease anyway — the lease only guards live-but-stuck)
-                timeout_s=base_timeout * len(gops)))
-        return plan, shards
+                timeout_s=base_timeout * len(gops),
+                rung=rung.name if rung is not None else "",
+                rung_width=rung.width if rung is not None else 0,
+                rung_height=rung.height if rung is not None else 0))
+        return shards
+
+    def _build_shards(self, job: Job, meta, num_frames: int,
+                      settings) -> tuple[SegmentPlan, list[Shard]]:
+        plan = self._plan_remote(num_frames, settings)
+        return plan, self._shards_for(job, meta, plan, settings,
+                                      qp=int(settings.qp))
 
     # -- encode stage override -----------------------------------------
 
@@ -650,6 +684,19 @@ class RemoteExecutor(LocalExecutor):
             job_id=job.id, host=self.host)
 
         stage[0] = "encode"
+        segments = [seg for shard in self._drain_board(job, token,
+                                                       settings, shards)
+                    for seg in shard.segments]
+        segments.sort(key=lambda s: s.gop.index)
+        return segments
+
+    def _drain_board(self, job: Job, token: str, settings,
+                     shards: list[Shard]) -> list[Shard]:
+        """Post the shards and babysit the farm until every one is
+        DONE: lease sweeps, progress writes (only on change — the store
+        is journal-backed), the all-workers-dead failsafe, and
+        token-fenced cleanup. Returns the completed shard records."""
+        co = self.coordinator
         self.board.add_job(
             job.id, shards,
             max_attempts=int(settings.part_failure_max_retries),
@@ -667,8 +714,6 @@ class RemoteExecutor(LocalExecutor):
                 done, total, retried, failed, failed_host = \
                     self.board.job_progress(job.id)
                 if (done, retried) != last_progress:
-                    # journal-backed store: only write on actual change,
-                    # not every poll tick
                     last_progress = (done, retried)
                     co.update_progress(
                         job.id, token, parts_done=done,
@@ -677,10 +722,7 @@ class RemoteExecutor(LocalExecutor):
                 if failed:
                     raise RuntimeError(failed)
                 if done >= total:
-                    segments = self.board.take_segments(job.id,
-                                                        token=token)
-                    segments.sort(key=lambda s: s.gop.index)
-                    return segments
+                    return self.board.take_shards(job.id, token=token)
                 live = self._live_workers()
                 if live:
                     workerless_since = None
@@ -698,6 +740,52 @@ class RemoteExecutor(LocalExecutor):
                 time.sleep(self.poll_s)
         finally:
             self.board.cancel_job(job.id, token=token)
+
+    def _encode_ladder(self, job: Job, token: str, frames, settings,
+                       meta, stage: list):
+        """Ladder jobs on the farm: rungs × GOP-range shards fan across
+        the workers (every rung shares ONE GOP plan, so segments align
+        no matter which host encodes which rung) and the coordinator
+        only groups the streamed-back parts per rung for packaging.
+        Direct-mode jobs still encode whole on the coordinator mesh."""
+        from ..abr.ladder import plan_ladder
+
+        co = self.coordinator
+        if str(getattr(job, "processing_mode", "split") or "split") \
+                == "direct":
+            co.activity.emit(
+                "encode", "direct mode: whole-ladder encode on the "
+                "coordinator mesh", job_id=job.id, host=self.host)
+            return super()._encode_ladder(job, token, frames, settings,
+                                          meta, stage)
+
+        stage[0] = "segment"
+        self._await_first_workers(job, token, settings)
+        rungs = plan_ladder(meta, settings)
+        plan = self._plan_remote(len(frames), settings)
+        shards: list[Shard] = []
+        for rung in rungs:
+            shards.extend(self._shards_for(job, meta, plan, settings,
+                                           qp=rung.qp, rung=rung))
+        total_parts = plan.num_gops * len(rungs)
+        co.update_progress(job.id, token, parts_total=total_parts,
+                           segment_progress=100.0)
+        co.heartbeat_job(
+            job.id, token, stage[0], host=self.host,
+            note=f"{plan.num_gops} GOPs x {len(rungs)} rungs in "
+                 f"{len(shards)} shards")
+        co.activity.emit(
+            "shard", f"dispatching {plan.num_gops} GOPs x {len(rungs)} "
+            f"rungs as {len(shards)} shards to the worker farm",
+            job_id=job.id, host=self.host)
+
+        stage[0] = "encode"
+        by_rung: dict[str, list] = {r.name: [] for r in rungs}
+        for shard in self._drain_board(job, token, settings, shards):
+            by_rung[shard.rung or rungs[0].name].extend(shard.segments)
+        for segs in by_rung.values():
+            segs.sort(key=lambda s: s.gop.index)
+        return rungs, by_rung
 
 
 # ---------------------------------------------------------------------------
@@ -731,8 +819,24 @@ def encode_shard(desc: Mapping[str, Any], frames, mesh=None
     gops = tuple(GopSpec(index=int(i), start_frame=int(s),
                          num_frames=int(n))
                  for i, s, n in desc["gops"])
-    enc = GopShardEncoder(meta, qp=int(desc["qp"]), mesh=mesh,
-                          gop_frames=int(desc.get("gop_frames", 32)))
+    rung_desc = desc.get("rung")
+    rung = None
+    if rung_desc and (int(rung_desc["width"]), int(rung_desc["height"])) \
+            != (meta.width, meta.height):
+        # scaled ladder rung: decode at source resolution, derive the
+        # rung on THIS worker's devices (abr/scale.py), encode at the
+        # rung's dims — the wire still carries plain segments
+        from ..abr.ladder import LadderShardEncoder, Rung
+
+        rung = Rung(name=str(rung_desc.get("name", "rung")),
+                    width=int(rung_desc["width"]),
+                    height=int(rung_desc["height"]), qp=int(desc["qp"]))
+        enc = LadderShardEncoder(meta, [rung], mesh=mesh,
+                                 gop_frames=int(desc.get("gop_frames",
+                                                         32)))
+    else:
+        enc = GopShardEncoder(meta, qp=int(desc["qp"]), mesh=mesh,
+                              gop_frames=int(desc.get("gop_frames", 32)))
     enc.plan_override = SegmentPlan(
         gops=gops, num_devices=enc.num_devices,
         frames_per_gop=int(desc.get("gop_frames", 32)))
@@ -745,6 +849,8 @@ def encode_shard(desc: Mapping[str, Any], frames, mesh=None
             f"{desc['input_path']}: shard wants frames "
             f"[{f0}, {f0 + int(desc['num_frames'])}) but clip has "
             f"{len(frames)}")
+    if rung is not None:
+        return [b.renditions[rung.name] for b in enc.encode(sub)]
     return enc.encode(sub)
 
 

@@ -55,6 +55,15 @@ DEFAULT_SETTINGS: dict[str, Any] = {
     "rc_mode": "cqp",                # cqp | vbr2pass
     "target_bitrate_kbps": 0.0,      # vbr2pass target; 0 = unset
     "qp": 27,
+    # ABR ladder subsystem (abr/): default job type for registrations
+    # that don't say (watch-folder drops named *.ladder.* always become
+    # ladder jobs), the rung heights (TVT_LADDER_RUNGS; heights at or
+    # above the source collapse into the source-resolution top rung),
+    # and the HLS media-segment target duration (TVT_SEGMENT_S; cut at
+    # closed-GOP boundaries so every rung segments identically).
+    "job_type": "transcode",         # transcode | ladder
+    "ladder_rungs": "1080,720,480,360",
+    "segment_s": 6.0,
     "software_fallback": True,       # pure-JAX CPU path when no TPU
     "profile_dir": "",               # non-empty: jax.profiler trace of
                                      # the encode stage lands here
@@ -150,6 +159,15 @@ def _coerce_like(default: Any, raw: Any) -> Any:
     return str(raw)
 
 
+def _clean_rung_spec(raw: Any) -> str:
+    """Normalize a ladder_rungs value via the canonical parser."""
+    from ..abr.ladder import parse_rung_heights
+
+    heights = parse_rung_heights(raw)
+    return ",".join(str(h) for h in heights) \
+        or DEFAULT_SETTINGS["ladder_rungs"]
+
+
 # Validation clamps applied on live updates, mirroring the reference's
 # POST /settings clamping (/root/reference/manager/app.py:1790-1916).
 _CLAMPS: dict[str, Callable[[Any], Any]] = {
@@ -160,6 +178,15 @@ _CLAMPS: dict[str, Callable[[Any], Any]] = {
     "pipeline_worker_count": lambda v: min(4096, max(1, as_int(v, 8))),
     "min_idle_workers": lambda v: max(0, as_int(v, 4)),
     "rc_mode": lambda v: str(v) if str(v) in ("cqp", "vbr2pass") else "cqp",
+    "job_type": lambda v: str(v)
+    if str(v) in ("transcode", "ladder")
+    else "transcode",
+    # sanitize through the one canonical rung-spec parser
+    # (abr/ladder.parse_rung_heights — jax-free, imported lazily so
+    # config stays import-light); an empty result falls back to the
+    # default ladder
+    "ladder_rungs": lambda v: _clean_rung_spec(v),
+    "segment_s": lambda v: min(60.0, max(1.0, as_float(v, 6.0))),
     "pack_workers": lambda v: min(256, max(0, as_int(v, 0))),
     "pipeline_window": lambda v: min(64, max(1, as_int(v, 4))),
     "pack_backend": lambda v: str(v)
@@ -300,7 +327,7 @@ def reset_live_settings() -> None:
 JOB_SETTING_KEYS = frozenset(
     {"gop_frames", "target_segment_frames", "qp", "rc_mode",
      "target_bitrate_kbps", "max_segments", "software_fallback",
-     "profile_dir"}
+     "profile_dir", "ladder_rungs", "segment_s"}
 )
 
 

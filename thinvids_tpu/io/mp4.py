@@ -95,6 +95,23 @@ def _matrix() -> bytes:
     return struct.pack(">9I", 0x10000, 0, 0, 0, 0x10000, 0, 0, 0, 0x40000000)
 
 
+def avc1_sample_entry(w: int, h: int, sps: bytes, pps: bytes) -> bytes:
+    """Complete avc1 VisualSampleEntry box (shared by the progressive
+    muxer below and the fMP4 HLS packager, abr/hls.py)."""
+    return _box(
+        b"avc1",
+        b"\x00" * 6, struct.pack(">H", 1),            # reserved + dref idx
+        b"\x00" * 16,
+        struct.pack(">HH", w, h),
+        struct.pack(">II", 0x480000, 0x480000),       # 72 dpi
+        b"\x00" * 4,
+        struct.pack(">H", 1),                         # frame count
+        b"\x00" * 32,                                 # compressor name
+        struct.pack(">Hh", 0x18, -1),                 # depth, color table
+        _avcc(sps, pps),
+    )
+
+
 @dataclasses.dataclass
 class Mp4Track:
     """One demuxed track, carried losslessly enough to re-mux.
@@ -175,18 +192,7 @@ def mux_mp4(stream: bytes, meta: VideoMeta,
     ftyp = _box(b"ftyp", b"isom", struct.pack(">I", 0x200),
                 b"isomiso2avc1mp41")
 
-    avc1 = _box(
-        b"avc1",
-        b"\x00" * 6, struct.pack(">H", 1),            # reserved + dref idx
-        b"\x00" * 16,
-        struct.pack(">HH", w, h),
-        struct.pack(">II", 0x480000, 0x480000),       # 72 dpi
-        b"\x00" * 4,
-        struct.pack(">H", 1),                         # frame count
-        b"\x00" * 32,                                 # compressor name
-        struct.pack(">Hh", 0x18, -1),                 # depth, color table
-        _avcc(sps, pps),
-    )
+    avc1 = avc1_sample_entry(w, h, sps, pps)
     sync = [i + 1 for i, k in enumerate(keys) if k]
     vmhd = _full(b"vmhd", 0, 1, struct.pack(">4H", 0, 0, 0, 0))
     smhd = _full(b"smhd", 0, 0, struct.pack(">HH", 0, 0))

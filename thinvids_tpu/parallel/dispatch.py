@@ -88,22 +88,28 @@ def default_mesh(devices=None) -> Mesh:
 #: canonical stage keys, in pipeline order (decode = pulling frames
 #: from the ingest source; stage = stack + H2D upload — both run on
 #: the staging thread when background_stage wraps the generator;
+#: scale = dispatching the device-side ABR downscale that derives
+#: lower ladder rungs from the staged wave (abr/scale.py);
 #: dense_retry = the rare wave-wide dense re-encode + wide fetch when
 #: the sparse budgets overflow — split out of "fetch" so the fetch
 #: number answers only "what does the COMMON bulk transfer cost")
-STAGE_NAMES = ("decode", "stage", "dispatch", "device_wait", "fetch",
-               "dense_retry", "sparse_unpack", "unflatten", "pack",
-               "concat")
+STAGE_NAMES = ("decode", "stage", "scale", "dispatch", "device_wait",
+               "fetch", "dense_retry", "sparse_unpack", "unflatten",
+               "pack", "concat")
 
 #: monotonic counters riding in the same snapshot as the stage clocks:
 #: dense_fallback_waves (waves that overflowed the sparse budgets and
-#: re-encoded dense), d2h_bytes (actual device→host bytes fetched —
-#: bench derives d2h_bytes_per_frame from it), fetch_shards (per-shard
-#: concurrent fetch transfers issued; 0 means every fetch was a single
-#: blocking device_get), proc_pack_gops (GOPs handed to the
-#: pack_backend=process sidecars instead of the thread pool)
-STAGE_COUNTERS = ("dense_fallback_waves", "d2h_bytes", "fetch_shards",
-                  "proc_pack_gops")
+#: re-encoded dense), h2d_bytes (host→device bytes uploaded while
+#: staging waves — the ABR ladder's proof that decode+upload happens
+#: ONCE per wave regardless of rung count: lower rungs derive on
+#: device, so this must not scale with rungs), d2h_bytes (actual
+#: device→host bytes fetched — bench derives d2h_bytes_per_frame from
+#: it), fetch_shards (per-shard concurrent fetch transfers issued; 0
+#: means every fetch was a single blocking device_get), proc_pack_gops
+#: (GOPs handed to the pack_backend=process sidecars instead of the
+#: thread pool)
+STAGE_COUNTERS = ("dense_fallback_waves", "h2d_bytes", "d2h_bytes",
+                  "fetch_shards", "proc_pack_gops")
 
 
 class StageProfile:
@@ -618,6 +624,8 @@ class GopShardEncoder:
                                for g in full])
                 qps = np.asarray([self.gop_qp.get(g.index, self.qp)
                                   for g in full], np.int32)
+                self.stages.bump("h2d_bytes", ys.nbytes + us.nbytes
+                                 + vs.nbytes + qps.nbytes)
                 staged = (wave, jnp.asarray(ys), jnp.asarray(us),
                           jnp.asarray(vs), jnp.asarray(qps))
             yield staged
@@ -631,6 +639,7 @@ class GopShardEncoder:
             with self.stages.stage("stage"):
                 ys = np.stack([self._gop_plane(cursor, g, F, "y")
                                for g in full])
+                self.stages.bump("h2d_bytes", ys.nbytes)
                 staged = (wave, jnp.asarray(ys))
             yield staged
 

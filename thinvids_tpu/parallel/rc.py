@@ -119,6 +119,29 @@ def solve_gop_qps(base_qp: int, pass1_bytes: np.ndarray,
     return np.clip(qps, QP_MIN, QP_MAX)
 
 
+def ladder_rung_qps(base_qp: int, pixel_ratios, alpha: float = 0.75
+                    ) -> np.ndarray:
+    """Per-rung QPs for an ABR ladder under the octave model.
+
+    At a fixed QP the model says R ∝ pixels · 2^(-qp/6); a good ladder
+    spends MORE bits per pixel as resolution drops (the classic
+    bitrate ladders follow R_rung ≈ R_top · ratio^alpha with
+    alpha < 1), so the QP shift that hits that target is
+
+        Δqp = 6 · (1 − alpha) · log2(pixel_ratio)     (ratio ≤ 1 → Δ ≤ 0)
+
+    i.e. lower rungs encode slightly FINER than the top rung.
+    `pixel_ratios` are rung_pixels / top_pixels (1.0 for the top rung,
+    which therefore keeps `base_qp` exactly — the byte-identity
+    invariant with the single-rendition path).
+    """
+    ratios = np.clip(np.asarray(pixel_ratios, np.float64), 1e-6, 1.0)
+    shift = _QP_PER_OCTAVE * (1.0 - float(alpha)) * np.log2(ratios)
+    qps = np.rint(base_qp + shift).astype(np.int32)
+    qps[ratios >= 1.0] = base_qp        # top rung: no rounding drift
+    return np.clip(qps, QP_MIN, QP_MAX)
+
+
 def refine_gop_qps(prev_qps: np.ndarray, actual_bits: float,
                    target_bits: float) -> np.ndarray:
     """One fixed-point step: shift every GOP's QP by the octave-model
