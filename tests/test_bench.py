@@ -52,8 +52,12 @@ def test_bench_result_schema_includes_stage_ms():
               "rung_bits_per_frame": {"1080p": 9000, "720p": 5000,
                                       "480p": 2500, "360p": 1500},
               "h2d_bytes": 123456}
+    live = {"latency_s": 0.41, "latency_p99_s": 0.62,
+            "dvr_segments": 2, "segment_s": 1.0, "ingest_fps": 12.5,
+            "gops": 6}
     result = bench.build_result(r, r4k, platform="cpu", qp=27, gop=8,
-                                n_1080=64, cold=cold, ladder=ladder)
+                                n_1080=64, cold=cold, ladder=ladder,
+                                live=live)
     assert result["value"] == 33.3
     assert result["fps_2160p"] == 2.8
     assert set(STAGE_NAMES) <= set(result["stage_ms"])
@@ -79,6 +83,27 @@ def test_bench_result_schema_includes_stage_ms():
     assert result["ladder_fps_1080p"] == 101.3
     assert result["ladder_rungs"] == 4
     assert result["ladder_bits_per_frame"]["360p"] == 1500
+    # live LL-HLS: glass-to-playlist latency (median + p99), the DVR
+    # window depth, and the paced ingest rate for context
+    assert result["live_latency_s"] == 0.41
+    assert result["live_latency_p99_s"] == 0.62
+    assert result["live_dvr_segments"] == 2
+    assert result["live_segment_s"] == 1.0
+    assert result["live_ingest_fps"] == 12.5
+
+
+def test_run_live_reports_glass_to_playlist_latency():
+    """The live bench drives the PRODUCTION live pipeline (paced
+    writer → tail → ladder → incremental packager → playlist poll) and
+    reports per-part latency percentiles."""
+    r = bench._run_live(64, 48, nframes=16, qp=27, gop_frames=4,
+                        rungs_spec="24", segment_s=0.25,
+                        dvr_window_s=0.0)
+    assert r["latency_s"] > 0
+    assert r["latency_p99_s"] >= r["latency_s"]
+    assert r["dvr_segments"] >= 1
+    assert r["gops"] >= 4
+    assert r["ingest_fps"] > 0
 
 
 def test_run_ladder_reports_aggregate_and_shared_upload():

@@ -553,6 +553,211 @@ def lint_ladder(out_dir: str, expected_duration_s: float | None = None
             "bandwidths": bandwidths}
 
 
+# ---------------------------------------------------------------------------
+# live / LL-HLS playlists (rendered incrementally by live/packager.py)
+# ---------------------------------------------------------------------------
+
+#: live part filenames: seg index + part index within the segment
+PART_PATTERN = "seg_%05d.part%02d.m4s"
+
+
+@dataclasses.dataclass
+class LivePart:
+    """One LL-HLS partial segment (here: one closed GOP's fragment)."""
+
+    uri: str
+    duration_s: float
+    independent: bool = True        # every part opens on an IDR
+
+
+@dataclasses.dataclass
+class LiveSegmentRef:
+    """One announced media segment of a live playlist."""
+
+    uri: str
+    duration_s: float
+    parts: list[LivePart] = dataclasses.field(default_factory=list)
+
+
+def render_live_media_playlist(
+        segments: list[LiveSegmentRef], open_parts: list[LivePart], *,
+        media_sequence: int, target_s: float, part_target_s: float,
+        preload_uri: str | None = None, event: bool = False,
+        ended: bool = False, parts_window: int = 1,
+        init_uri: str = INIT_NAME) -> str:
+    """Render a live/EVENT media playlist snapshot (RFC 8216bis).
+
+    `segments` are the CLOSED segments still inside the DVR window
+    (playlist order); `open_parts` are the in-progress segment's
+    already-written partial segments, announced the moment each closed
+    GOP clears the ladder — the sub-segment-latency half of LL-HLS.
+    Parts are listed for the open segment plus the last `parts_window`
+    closed segments (older parts may be dropped per spec); a
+    `preload_uri` hint names the NEXT part so a player can open its
+    request before the encoder finishes it. `ended` appends
+    EXT-X-ENDLIST (and suppresses parts/hints — a closed stream
+    announces nothing further); `event` marks a no-GC playlist
+    (EXT-X-PLAYLIST-TYPE:EVENT is only legal when segments are never
+    removed, so the packager sets it iff the DVR window is unbounded).
+    """
+    lines = [
+        "#EXTM3U",
+        "#EXT-X-VERSION:9",
+        f"#EXT-X-TARGETDURATION:{max(1, math.ceil(target_s))}",
+        f"#EXT-X-SERVER-CONTROL:CAN-BLOCK-RELOAD=YES,"
+        f"PART-HOLD-BACK={3 * part_target_s:.5f}",
+        f"#EXT-X-PART-INF:PART-TARGET={part_target_s:.5f}",
+        f"#EXT-X-MEDIA-SEQUENCE:{media_sequence}",
+    ]
+    if event:
+        lines.append("#EXT-X-PLAYLIST-TYPE:EVENT")
+    lines += ["#EXT-X-INDEPENDENT-SEGMENTS",
+              f'#EXT-X-MAP:URI="{init_uri}"']
+
+    def part_lines(parts: list[LivePart]) -> list[str]:
+        return [
+            f'#EXT-X-PART:DURATION={p.duration_s:.5f},URI="{p.uri}"'
+            + (",INDEPENDENT=YES" if p.independent else "")
+            for p in parts]
+
+    first_with_parts = len(segments) - max(0, parts_window)
+    for i, seg in enumerate(segments):
+        if not ended and i >= first_with_parts:
+            lines += part_lines(seg.parts)
+        lines.append(f"#EXTINF:{seg.duration_s:.5f},")
+        lines.append(seg.uri)
+    if ended:
+        lines.append("#EXT-X-ENDLIST")
+    else:
+        lines += part_lines(open_parts)
+        if preload_uri:
+            lines.append(
+                f'#EXT-X-PRELOAD-HINT:TYPE=PART,URI="{preload_uri}"')
+    return "\n".join(lines) + "\n"
+
+
+def live_playlist_state(text: str) -> dict:
+    """Cheap live-edge facts out of a media playlist snapshot — the
+    LL-HLS blocking-reload gate (api/server.py `_HLS_msn`/`_HLS_part`)
+    and the live lint both read this.
+
+    Returns {"media_sequence", "segments", "next_msn", "next_part",
+    "parts", "part_target", "target", "ended", "has_map",
+    "has_server_control", "has_preload_hint", "durations",
+    "part_durations"} where `next_msn` is the media sequence number
+    the OPEN (not yet announced as whole) segment will get and
+    `next_part` is how many of its parts are already announced.
+    """
+    media_seq = 0
+    target = None
+    part_target = None
+    durations: list[float] = []
+    has_map = ended = has_sc = has_hint = False
+    pending_inf = False
+    # parts attach to the segment that FOLLOWS them in the playlist;
+    # parts after the last EXTINF belong to the open segment
+    open_parts: list[float] = []
+    part_durations: list[float] = []
+    for raw in text.splitlines():
+        line = raw.strip()
+        if line.startswith("#EXT-X-MEDIA-SEQUENCE:"):
+            media_seq = int(line.split(":", 1)[1])
+        elif line.startswith("#EXT-X-TARGETDURATION:"):
+            target = int(line.split(":", 1)[1])
+        elif line.startswith("#EXT-X-PART-INF:"):
+            attrs = _parse_attr_list(line.split(":", 1)[1])
+            part_target = float(attrs.get("PART-TARGET", 0) or 0)
+        elif line.startswith("#EXT-X-SERVER-CONTROL:"):
+            has_sc = "CAN-BLOCK-RELOAD=YES" in line
+        elif line.startswith("#EXT-X-MAP:"):
+            has_map = True
+        elif line.startswith("#EXT-X-PART:"):
+            attrs = _parse_attr_list(line.split(":", 1)[1])
+            dur = float(attrs.get("DURATION", 0) or 0)
+            open_parts.append(dur)
+            part_durations.append(dur)
+        elif line.startswith("#EXT-X-PRELOAD-HINT:"):
+            has_hint = True
+        elif line.startswith("#EXTINF:"):
+            durations.append(float(
+                line.split(":", 1)[1].rstrip(",").split(",")[0]))
+            pending_inf = True
+        elif line == "#EXT-X-ENDLIST":
+            ended = True
+        elif line and not line.startswith("#") and pending_inf:
+            pending_inf = False
+            open_parts = []         # those parts belonged to this URI
+    return {
+        "media_sequence": media_seq,
+        "segments": len(durations),
+        "next_msn": media_seq + len(durations),
+        "next_part": len(open_parts),
+        "parts": len(part_durations),
+        "part_target": part_target,
+        "target": target,
+        "ended": ended,
+        "has_map": has_map,
+        "has_server_control": has_sc,
+        "has_preload_hint": has_hint,
+        "durations": durations,
+        "part_durations": part_durations,
+    }
+
+
+def lint_live_media_playlist(path: str, prev: dict | None = None) -> dict:
+    """Conformance lint for ONE live media-playlist snapshot, with
+    optional cross-reload monotonicity against the previous snapshot's
+    returned state.
+
+    Checks: TARGETDURATION/MAP present; while open, PART-INF +
+    blocking-reload SERVER-CONTROL advertised and no EXT-X-ENDLIST;
+    every EXTINF within the TARGETDURATION bound and every part
+    DURATION within PART-TARGET; an ENDED playlist must not announce
+    a preload hint (a closed stream promising more parts is a
+    contradiction). With `prev`: EXT-X-MEDIA-SEQUENCE never goes
+    backwards, the (next_msn, next_part) live edge never retreats,
+    and an ended stream never reopens. Returns the state dict to
+    thread into the next call; raises ValueError on violations.
+    """
+    with open(path, encoding="utf-8") as fp:
+        st = live_playlist_state(fp.read())
+    if st["target"] is None or not st["has_map"]:
+        raise ValueError(f"{path}: missing TARGETDURATION/MAP")
+    if not st["ended"]:
+        if st["part_target"] is None:
+            raise ValueError(f"{path}: open live playlist without "
+                             f"EXT-X-PART-INF")
+        if not st["has_server_control"]:
+            raise ValueError(f"{path}: open live playlist without "
+                             f"CAN-BLOCK-RELOAD server control")
+    if st["ended"] and st["has_preload_hint"]:
+        raise ValueError(f"{path}: ENDLIST playlist still announces a "
+                         f"preload hint")
+    for d in st["durations"]:
+        if round(d) > st["target"]:
+            raise ValueError(f"{path}: EXTINF {d:.3f}s exceeds "
+                             f"TARGETDURATION {st['target']}")
+    if st["part_target"] is not None:
+        for d in st["part_durations"]:
+            if d > st["part_target"] + 1e-3:
+                raise ValueError(
+                    f"{path}: part DURATION {d:.3f}s exceeds "
+                    f"PART-TARGET {st['part_target']:.3f}")
+    if prev is not None:
+        if st["media_sequence"] < prev["media_sequence"]:
+            raise ValueError(
+                f"{path}: EXT-X-MEDIA-SEQUENCE went backwards "
+                f"({prev['media_sequence']} -> {st['media_sequence']})")
+        edge = (st["next_msn"], st["next_part"])
+        prev_edge = (prev["next_msn"], prev["next_part"])
+        if edge < prev_edge:
+            raise ValueError(f"{path}: live edge retreated "
+                             f"{prev_edge} -> {edge}")
+        if prev["ended"] and not st["ended"]:
+            raise ValueError(f"{path}: ended stream reopened")
+    return st
+
+
 def init_video_entry(init: bytes) -> bytes:
     """The avc1 sample entry out of an init segment (decode read-back:
     feed with the fragment samples to io/mp4._avcc_to_annexb)."""

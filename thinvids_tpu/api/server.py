@@ -65,11 +65,15 @@ _SORTABLE = {f.name for f in dataclasses.fields(Job)
 
 class _FileResponse:
     """Handler payload sentinel: stream a file instead of JSON (the
-    reference's send_file preview, manager/app.py:2402-2460)."""
+    reference's send_file preview, manager/app.py:2402-2460).
+    `headers` are extra response headers (Cache-Control for the HLS
+    routes — a CDN in front of the origin keys on these)."""
 
-    def __init__(self, path: str, content_type: str) -> None:
+    def __init__(self, path: str, content_type: str,
+                 headers: dict[str, str] | None = None) -> None:
         self.path = path
         self.content_type = content_type
+        self.headers = dict(headers or {})
 
 
 class ApiServer:
@@ -140,6 +144,8 @@ class ApiServer:
                     self.send_response(200)
                     self.send_header("Content-Type", fr.content_type)
                     self.send_header("Content-Length", str(size))
+                    for key, value in fr.headers.items():
+                        self.send_header(key, value)
                     self.end_headers()
                     try:
                         while True:
@@ -315,7 +321,7 @@ class ApiServer:
             raise ApiError(422, str(exc))
         job_type = body.get("job_type")
         if job_type is not None and job_type not in ("transcode",
-                                                     "ladder"):
+                                                     "ladder", "live"):
             raise ApiError(400, f"unknown job_type {job_type!r}")
         job = self.coordinator.add_job(
             input_path, meta, settings=body.get("settings"),
@@ -465,11 +471,12 @@ class ApiServer:
     def _h_preview(self, query, body, job_id) -> tuple[int, Any]:
         """Stream a DONE job's output file (reference /preview/<id>)."""
         job = self._get_job(job_id)
-        if job.job_type == "ladder":
-            # a ladder's output_path is a playlist, not a previewable
+        if job.job_type in ("ladder", "live"):
+            # these jobs' output_path is a playlist, not a previewable
             # MP4 — labelling it video/mp4 would hand players garbage
             raise ApiError(
-                409, f"ladder job: tune to /hls/{job_id}/master.m3u8")
+                409,
+                f"{job.job_type} job: tune to /hls/{job_id}/master.m3u8")
         if not job.output_path or not os.path.exists(job.output_path):
             raise ApiError(404, "job has no output file")
         return 200, _FileResponse(job.output_path, "video/mp4")
@@ -482,26 +489,98 @@ class ApiServer:
     }
 
     def _h_hls(self, query, body, job_id, rel) -> tuple[int, Any]:
-        """Serve a DONE ladder job's HLS tree: master/media playlists,
+        """Serve a ladder/live job's HLS tree: master/media playlists,
         init segments, and fMP4 fragments — `/hls/<job>/master.m3u8`
         is what a player tunes to, and the playlists' relative URIs
         resolve naturally under the same prefix. Traversal-safe within
-        the job's packaged output directory."""
+        the job's packaged output directory.
+
+        Ladder (batch) jobs serve after completion; LIVE jobs serve
+        the moment the executor publishes the tree (output
+        availability is decoupled from job completion). Cache-Control
+        is set for CDN fronting: live playlists are `no-cache` (they
+        rewrite every part), finished-VOD playlists cache briefly, and
+        segments/init are content-immutable once written. LL-HLS
+        blocking playlist reload is supported on media playlists via
+        the standard `_HLS_msn` / `_HLS_part` query params: the
+        response is held until the playlist's live edge reaches the
+        requested (msn, part) or the hold budget expires."""
         job = self._get_job(job_id)
-        if job.job_type != "ladder":
-            raise ApiError(404, f"job {job_id} is not a ladder job")
+        if job.job_type not in ("ladder", "live"):
+            raise ApiError(404, f"job {job_id} is not an HLS job")
         if not job.output_path or not os.path.exists(job.output_path):
-            raise ApiError(404, "job has no packaged HLS output")
+            raise ApiError(404, "job has no packaged HLS output"
+                           + (" yet" if job.job_type == "live" else ""))
         root = os.path.realpath(os.path.dirname(job.output_path))
         target = os.path.realpath(os.path.join(root, rel))
         if target != root and not target.startswith(root + os.sep):
             raise ApiError(400, "path escapes the HLS root")
-        ctype = self._HLS_TYPES.get(os.path.splitext(target)[1].lower())
+        ext = os.path.splitext(target)[1].lower()
+        ctype = self._HLS_TYPES.get(ext)
         if ctype is None:
             raise ApiError(404, f"not an HLS resource: {rel}")
+        live_open = job.job_type == "live" \
+            and job.status is not Status.DONE
+        if ext == ".m3u8":
+            if "_HLS_msn" in query:
+                self._block_for_playlist_edge(target, query, live_open)
+            # live playlists rewrite after every part — a cached copy
+            # is stale within one part duration; finished VOD
+            # playlists are stable but kept revalidatable
+            headers = {"Cache-Control": "no-cache" if live_open
+                       else "public, max-age=30"}
+        else:
+            # segments, parts and init are immutable once written
+            # (new content always gets a NEW uri) — let a CDN keep
+            # them for as long as it likes
+            headers = {"Cache-Control":
+                       "public, max-age=31536000, immutable"}
         if not os.path.isfile(target):
             raise ApiError(404, f"no such HLS file {rel!r}")
-        return 200, _FileResponse(target, ctype)
+        return 200, _FileResponse(target, ctype, headers=headers)
+
+    #: cap on one blocking playlist reload (seconds); the spec wants
+    #: blocking requests answered as soon as the edge advances, and a
+    #: dead stream must time out rather than pin the connection
+    _BLOCK_RELOAD_MAX_S = 15.0
+
+    def _block_for_playlist_edge(self, path: str, query: dict[str, str],
+                                 live_open: bool) -> None:
+        """LL-HLS blocking playlist reload (RFC 8216bis §6.2.5.2):
+        hold the response until the media playlist contains media
+        sequence number `_HLS_msn` (and, if given, part `_HLS_part` of
+        it), the stream ends, or the hold budget expires — whichever
+        comes first. Non-live playlists return immediately (their edge
+        never moves)."""
+        from ..abr.hls import live_playlist_state
+
+        try:
+            want_msn = int(query["_HLS_msn"])
+            raw_part = query.get("_HLS_part")
+            # no _HLS_part = hold for the WHOLE segment with that MSN
+            # (a -1 default would satisfy on the open segment's first
+            # part and degrade blocking reload into a busy-poll)
+            want_part = None if raw_part is None else int(raw_part)
+        except (TypeError, ValueError):
+            raise ApiError(400, "_HLS_msn/_HLS_part must be integers")
+        if want_msn < 0 or not live_open:
+            return
+        import time as _time
+
+        deadline = _time.monotonic() + self._BLOCK_RELOAD_MAX_S
+        while _time.monotonic() < deadline:
+            try:
+                with open(path, encoding="utf-8") as fp:
+                    st = live_playlist_state(fp.read())
+            except OSError:
+                st = None
+            if st is not None:
+                if st["ended"] or want_msn < st["next_msn"]:
+                    return
+                if want_part is not None and want_msn == st["next_msn"] \
+                        and want_part < st["next_part"]:
+                    return
+            _time.sleep(0.02)
 
     def _h_stamp_job(self, query, body, job_id) -> tuple[int, Any]:
         """Create a frame-index-watermarked copy of the job's source and

@@ -159,6 +159,50 @@ def run_worker(args: argparse.Namespace) -> None:
         agent.stop()
 
 
+def run_feed(args: argparse.Namespace) -> None:
+    """Pace a finished y4m into a GROWING `.live.` drop — the live
+    pipeline's reference writer (demo + load driver): frame records
+    append at `--rate` × real time, then the ``.eos`` marker closes
+    the stream explicitly so the tailer doesn't wait out its stall
+    budget. Point it at the coordinator's watch dir and the watcher
+    submits the live job on first sighting (ingest/watcher.py)."""
+    import time
+
+    from .core.log import get_logging
+    from .ingest.tail import EOS_SUFFIX, is_live_name
+    from .io.y4m import Y4MRangeReader
+
+    log = get_logging("thinvids_tpu.feed")
+    if not is_live_name(args.dest):
+        log.warning("%s does not follow the <name>.live.<ext> "
+                    "convention; the watcher will treat it as a batch "
+                    "file", args.dest)
+    src = Y4MRangeReader(args.source)
+    fps = src.meta.fps or 30.0
+    delay = 0.0 if args.rate <= 0 else 1.0 / (fps * args.rate)
+    # a previous feed's end-of-stream marker must not survive into
+    # this run — a stale .eos makes the tailer finalize immediately
+    for stale in (args.dest, args.dest + EOS_SUFFIX):
+        try:
+            os.unlink(stale)
+        except OSError:
+            pass
+    with open(args.source, "rb") as inp, open(args.dest, "wb") as out:
+        out.write(inp.read(src._data_start))
+        out.flush()
+        next_at = time.monotonic()
+        for i in range(src.num_frames):
+            out.write(inp.read(src._record))
+            out.flush()
+            if delay:
+                next_at += delay
+                time.sleep(max(0.0, next_at - time.monotonic()))
+    with open(args.dest + EOS_SUFFIX, "wb"):
+        pass
+    log.info("fed %d frames into %s (%.2fx real time)", src.num_frames,
+             args.dest, args.rate if args.rate > 0 else float("inf"))
+
+
 def run_agent(args: argparse.Namespace) -> None:
     from .cluster.agent import NodeAgent, http_submitter
     from .core.log import get_logging
@@ -218,6 +262,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="claim poll interval when idle (s); default "
                         "from remote_claim_poll_s")
     w.set_defaults(fn=run_worker)
+
+    f = sub.add_parser("feed", help="pace a y4m into a growing .live "
+                                    "drop (live-ingest writer)")
+    f.add_argument("source", help="finished .y4m clip to stream out")
+    f.add_argument("dest", help="growing file to append into "
+                                "(<name>.live.y4m under the watch dir)")
+    f.add_argument("--rate", type=float, default=1.0,
+                   help="pacing as a multiple of real time "
+                        "(0 = as fast as possible)")
+    f.set_defaults(fn=run_feed)
     return p
 
 

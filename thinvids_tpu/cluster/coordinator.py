@@ -181,10 +181,11 @@ class Coordinator:
         /root/reference/manager/app.py:2222-2400).
 
         `job_type` resolution: explicit argument > the ``name.ladder.ext``
-        filename convention (the stem must END with ``.ladder``, so a
-        watch-folder drop can opt into the ABR ladder per file without
-        derived names like ``clip.ladder.stamped.y4m`` inheriting it) >
-        the ``job_type`` setting."""
+        / ``name.live.ext`` filename conventions (the stem must END with
+        the suffix, so a watch-folder drop can opt into the ABR ladder
+        or live ingest per file without derived names like
+        ``clip.ladder.stamped.y4m`` inheriting it) > the ``job_type``
+        setting."""
         import os as _os
 
         snap = self._settings_fn()
@@ -193,10 +194,12 @@ class Coordinator:
                 _os.path.basename(input_path))[0].lower()
             if stem.endswith(".ladder"):
                 job_type = "ladder"
+            elif stem.endswith(".live"):
+                job_type = "live"
             else:
                 job_type = str(snap.get("job_type", "transcode")
                                or "transcode")
-        if job_type not in ("transcode", "ladder"):
+        if job_type not in ("transcode", "ladder", "live"):
             raise ValueError(f"unknown job_type {job_type!r}")
         decision = evaluate_job_policy(meta, snap)
         job = self.store.create(input_path, meta=meta, settings=settings,
@@ -356,6 +359,21 @@ class Coordinator:
         def apply(j: Job) -> None:
             j.status = Status.RUNNING
         self.store.update(job_id, apply)
+        return True
+
+    def publish_output(self, job_id: str, token: str,
+                       output_path: str) -> bool:
+        """Announce a job's output location while it is STILL RUNNING —
+        the live pipeline's decoupling of output availability from job
+        completion: /hls starts serving the playlist tree the moment
+        the packager writes it, not when the stream ends. Token-fenced
+        like every executor callback."""
+        if not self.token_is_current(job_id, token):
+            return False
+        self.store.update(job_id, lambda j: setattr(
+            j, "output_path", output_path))
+        self.activity.emit("publish", f"serving live → {output_path}",
+                           job_id=job_id)
         return True
 
     def complete_job(self, job_id: str, token: str, output_path: str,

@@ -35,6 +35,26 @@ class HaltedError(RuntimeError):
     """Run token went stale mid-run (stop/restart/watchdog revocation)."""
 
 
+def _live_batch_plan(num_frames: int, gop_frames: int,
+                     num_devices: int):
+    """Fixed GOP grid for one live batch: exactly `gop_frames` per GOP
+    (short tail at end of stream), indices local to the batch. The
+    default planner's wave balancing would split GOPs differently per
+    batch size / mesh width, making live part boundaries
+    nondeterministic."""
+    from ..core.types import GopSpec, SegmentPlan
+
+    gops = []
+    start = 0
+    while start < num_frames:
+        n = min(gop_frames, num_frames - start)
+        gops.append(GopSpec(index=len(gops), start_frame=start,
+                            num_frames=n))
+        start += n
+    return SegmentPlan(gops=tuple(gops), num_devices=num_devices,
+                       frames_per_gop=gop_frames)
+
+
 class _WaveExhausted(RuntimeError):
     """One wave burned its whole retry budget; carries the segments the
     failing range completed so an elastic replan can resume after them."""
@@ -100,6 +120,15 @@ class LocalExecutor:
         try:
             settings = co.job_settings(job)
             co.heartbeat_job(job.id, token, stage[0], host=self.host)
+            if getattr(job, "job_type", "transcode") == "live":
+                # live LL-HLS: the source is still GROWING — tail it
+                # GOP-by-GOP and serve viewers during ingest (live/).
+                # Always encoded on this process's mesh, even under the
+                # remote backend: farming one GOP at a time would put a
+                # worker round-trip inside the glass-to-playlist path.
+                with self._maybe_trace(settings, job):
+                    self._run_live(job, token, settings, stage)
+                return
             # streaming ingest: open (header parse / container demux)
             # WITHOUT decoding — frames decode wave-by-wave during the
             # encode, so the clip never materializes in host RAM and
@@ -262,6 +291,168 @@ class LocalExecutor:
         master = os.path.join(out_dir, hls.MASTER_PLAYLIST)
         co.update_progress(job.id, token, combine_progress=100.0)
         co.complete_job(job.id, token, master, total)
+
+    def _run_live(self, job: Job, token: str, settings,
+                  stage: list) -> None:
+        """Live LL-HLS pipeline: tail the growing source, encode each
+        completed GOP through the ladder encoders wave-by-wave, and
+        hand every finished GOP bundle to the incremental packager —
+        output availability is decoupled from job completion (the
+        master playlist is published, and /hls serves it, after the
+        FIRST GOP clears all rungs).
+
+        Latency model: at the live edge one GOP encodes at a time
+        (glass-to-playlist ≈ GOP duration + one wave's encode+package);
+        during backlog/catch-up, up to one full wave of GOPs batches
+        per dispatch. End-of-stream is the tail source's stall timeout
+        (`live_stall_s`) or `.eos` marker; the packager then finalizes
+        with EXT-X-ENDLIST and — when nothing was GC'd out of the DVR
+        window — the tree passes the full VOD conformance lint. Waves
+        do not retry or replan here: a live edge cannot rewind, so a
+        wave failure fails the job with attribution."""
+        import shutil
+
+        from ..abr import hls
+        from ..abr.ladder import LadderShardEncoder, plan_ladder
+        from ..ingest.tail import TailFrameSource
+        from ..live.packager import LiveLadderPackager
+
+        co = self.coordinator
+        stage[0] = "tail"
+        stall = float(settings.get("live_stall_s", 10.0))
+        tail = TailFrameSource(job.input_path, stall_timeout_s=stall)
+        meta = tail.meta                    # header facts; num_frames grows
+        if not co.mark_running(job.id, token):
+            raise HaltedError("fenced before start")
+        gop_n = int(settings.gop_frames)
+        rungs = plan_ladder(meta, settings)
+        enc = LadderShardEncoder(
+            meta, rungs, mesh=self.mesh, gop_frames=gop_n,
+            max_segments=int(settings.max_segments))
+        base = os.path.splitext(os.path.basename(job.input_path))[0]
+        out_dir = os.path.join(self.output_dir, base + ".hls")
+        os.makedirs(self.output_dir, exist_ok=True)
+        # a restarted live job re-tails from frame 0: the previous
+        # attempt's tree is stale output, not resumable state
+        shutil.rmtree(out_dir, ignore_errors=True)
+        packager = LiveLadderPackager(
+            out_dir, rungs, meta.fps_num, meta.fps_den,
+            segment_s=float(settings.get("segment_s", 6.0)),
+            gop_frames=gop_n,
+            dvr_window_s=float(settings.get("dvr_window_s", 0.0)))
+        co.heartbeat_job(
+            job.id, token, stage[0], host=self.host,
+            note=f"tailing x{len(rungs)} rungs (stall {stall:.0f}s)")
+
+        def fenced() -> bool:
+            return not co.token_is_current(job.id, token)
+
+        stage[0] = "encode"
+        # Prime the jit cache for the live-edge wave shape NOW, while
+        # the source is still filling its first GOP: the first part's
+        # glass-to-playlist latency must not pay the compile (tens of
+        # seconds on a real TPU). One dummy wave, output discarded.
+        self._warm_live_shapes(enc, meta, gop_n)
+        wave_cap = enc.num_devices * enc.gops_per_wave
+        frames_done = gops_done = 0
+        published = False
+        while True:
+            avail = tail.wait_frames(frames_done + gop_n,
+                                     stop_check=fenced)
+            if fenced():
+                raise HaltedError("stale run token")
+            if avail <= frames_done and tail.ended:
+                break
+            if tail.ended:
+                # drain wave-by-wave (the final partial GOP rides the
+                # last batch) — never one giant batch, a fast writer
+                # can leave an arbitrarily deep backlog at EOS
+                count = min(avail - frames_done, wave_cap * gop_n)
+            else:
+                whole = (avail - frames_done) // gop_n
+                # at the live edge whole==1 (lowest latency); during
+                # catch-up batch up to one wave per dispatch
+                count = min(whole, wave_cap) * gop_n
+            # GOP indices / frame ranges continue the global stream
+            # (same offset contract the elastic replan uses), and the
+            # batch's GOP boundaries are pinned EXPLICITLY: the local
+            # planner balances GOP lengths to the mesh width, which
+            # would make part boundaries depend on arrival timing and
+            # device count — a live stream's GOP grid must be a pure
+            # function of the frame index (gop_frames-sized, like the
+            # remote backend's shard plan_override contract)
+            enc.gop_index_offset = gops_done
+            enc.frame_offset = frames_done
+            enc.plan_override = _live_batch_plan(count, gop_n,
+                                                 enc.num_devices)
+            # lazy window, not a materialized list: the staging thread
+            # decodes the batch wave-by-wave (bounded residency, same
+            # contract as batch ingest)
+            bundles = enc.encode(tail[frames_done:frames_done + count])
+            for bundle in bundles:
+                packager.add_gop(bundle)
+            if not published:
+                # the served tree now exists: announce it while the
+                # job keeps RUNNING — viewers join during ingest
+                co.publish_output(job.id, token, packager.master_path)
+                published = True
+            gops_done += len(bundles)
+            frames_done += count
+            co.update_progress(job.id, token, parts_total=gops_done,
+                               parts_done=gops_done,
+                               segment_progress=100.0)
+            co.heartbeat_job(
+                job.id, token, stage[0], host=self.host,
+                note=f"live edge: {gops_done} GOPs, "
+                     f"{packager.segments_announced} segments, "
+                     f"{packager.segments_gced} GC'd")
+        if gops_done == 0:
+            raise ValueError(
+                f"live source {job.input_path} ended with no frames")
+
+        stage[0] = "finalize"
+        co.heartbeat_job(job.id, token, stage[0], host=self.host,
+                         note="end of stream; writing ENDLIST")
+        packager.close()
+        fps = meta.fps_num / max(1, meta.fps_den)
+        if packager.segments_gced == 0:
+            # nothing left the DVR window: the closed tree is a full
+            # VOD and must pass the batch conformance gate unchanged
+            hls.lint_ladder(out_dir,
+                            expected_duration_s=frames_done / fps)
+        else:
+            for r in rungs:
+                hls.lint_live_media_playlist(os.path.join(
+                    out_dir, r.name, hls.MEDIA_PLAYLIST))
+        self._emit_stage_breakdown(job, enc)
+        co.update_progress(job.id, token, encode_progress=100.0,
+                           combine_progress=100.0)
+        co.complete_job(job.id, token, packager.master_path,
+                        packager.total_bytes())
+
+    @staticmethod
+    def _warm_live_shapes(enc, meta, gop_n: int) -> None:
+        """Compile the live-edge wave program (one gop_n-frame GOP,
+        padded to the mesh width like every live batch) on synthetic
+        frames before real ones arrive — overlap jit compile with the
+        source's first-GOP fill instead of serializing it into the
+        first part's latency."""
+        import numpy as np
+
+        from ..core.types import Frame
+
+        h, w = meta.height, meta.width
+        dummy = [Frame(y=np.zeros((h, w), np.uint8),
+                       u=np.full((h // 2, w // 2), 128, np.uint8),
+                       v=np.full((h // 2, w // 2), 128, np.uint8))
+                 for _ in range(gop_n)]
+        enc.plan_override = _live_batch_plan(gop_n, gop_n,
+                                             enc.num_devices)
+        try:
+            enc.encode(dummy)
+        except Exception:       # noqa: BLE001 - warm is best-effort;
+            pass                # a real defect fails the REAL first
+                                # wave with proper attribution
 
     def _emit_stage_breakdown(self, job: Job, enc) -> None:
         """Record the encoder's host-stage wall-clock breakdown (wave

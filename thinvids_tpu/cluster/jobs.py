@@ -37,7 +37,10 @@ class Job:
     meta: VideoMeta | None = None
     status: Status = Status.READY
     # what the job produces: "transcode" = single-rendition MP4,
-    # "ladder" = the ABR rendition set packaged as HLS (abr/)
+    # "ladder" = the ABR rendition set packaged as HLS (abr/),
+    # "live" = LL-HLS ladder tailed from a GROWING source — output
+    # (the served playlist tree) becomes available DURING the run,
+    # not at completion (live/)
     job_type: str = "transcode"
     # settings overlay (core.config.JOB_SETTING_KEYS subset)
     settings: dict[str, Any] = dataclasses.field(default_factory=dict)

@@ -9,8 +9,10 @@ durable processed ledger, probed, and submitted as jobs.
 from .decode import (DecodeError, FrameSource, open_video, read_video,
                      supported_exts)
 from .probe import ProbeError, probe_video
+from .tail import TailFrameSource, is_live_name, spool_stream
 from .watcher import FileLedger, WatchIngester, coordinator_submitter
 
 __all__ = ["DecodeError", "FrameSource", "ProbeError", "probe_video",
            "open_video", "read_video", "supported_exts", "FileLedger",
-           "WatchIngester", "coordinator_submitter"]
+           "WatchIngester", "coordinator_submitter", "TailFrameSource",
+           "is_live_name", "spool_stream"]

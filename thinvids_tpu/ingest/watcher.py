@@ -27,6 +27,7 @@ import threading
 from typing import Callable, Iterable
 
 from .decode import supported_exts as decode_supported_exts
+from .tail import is_live_name
 
 try:
     import fcntl
@@ -222,11 +223,18 @@ class WatchIngester:
                 self.ledger.mark(rel, sig)
                 continue
 
-            prev_sig, streak = self._stability.get(rel, (None, 0))
-            streak = streak + 1 if sig == prev_sig else 1
-            self._stability[rel] = (sig, streak)
-            if streak < self.stable_checks:
-                continue                         # still stabilizing
+            # live-named drops are INGEST STREAMS, not settled files: a
+            # growing source never passes the stability gate (its size
+            # changes every scan by design), so `.live.` names submit
+            # on first sighting and the tail source follows the growth
+            # (ingest/tail.py — the watch-folder-as-ingest model
+            # generalized to a file that never settles)
+            if not is_live_name(rel):
+                prev_sig, streak = self._stability.get(rel, (None, 0))
+                streak = streak + 1 if sig == prev_sig else 1
+                self._stability[rel] = (sig, streak)
+                if streak < self.stable_checks:
+                    continue                     # still stabilizing
 
             abs_path = os.path.join(self.watch_dir, rel)
             try:
@@ -238,7 +246,8 @@ class WatchIngester:
                 # file changed while the submit ran, the next scan sees
                 # 'changed' and requeues the final content.
                 self.ledger.mark(rel, sig)
-                del self._stability[rel]
+                self._stability.pop(rel, None)   # live names never
+                                                 # entered stabilization
                 submitted.append(rel)
         return submitted
 
@@ -262,9 +271,21 @@ def coordinator_submitter(coordinator, activity_host: str = "watcher"):
     from .probe import ProbeError, probe_video
 
     def submit(abs_path: str, state: str = "missing") -> bool:
+        # A growing live source keeps changing its ledger signature on
+        # every scan; once its live job is registered and not terminal,
+        # each new sighting is EXPECTED GROWTH, not a re-drop — ledger
+        # the new signature (True) and leave the running tail alone.
+        if is_live_name(abs_path) and any(
+                j.input_path == abs_path and not j.status.is_terminal
+                for j in coordinator.store):
+            return True
         try:
             meta = probe_video(abs_path)
         except ProbeError as exc:
+            if is_live_name(abs_path):
+                # live drop whose header isn't on disk yet: retry on a
+                # later scan rather than blacklisting the stream
+                return False
             if isinstance(exc.__cause__, OSError):
                 # transient I/O (NFS hiccup, EACCES-until-chmod): retry
                 # on a later scan — ledgering now would blacklist the
