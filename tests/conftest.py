@@ -9,8 +9,33 @@ thinvids_tpu.core.devices (shared with the driver's dryrun entry point).
 import os
 import sys
 
+import pytest
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from thinvids_tpu.core.devices import force_cpu_devices  # noqa: E402
 
 force_cpu_devices(8)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running (sanitizer fuzz, big corpora); excluded "
+        "from the tier-1 run (-m 'not slow')")
+
+
+@pytest.fixture(scope="session")
+def analysis_ctx():
+    """(manifest, SourceTree over the package + bench.py) — the same
+    tree `cli.py check` analyzes. Shared by the subsystem-contract
+    tests that migrated off the old grep guards (test_abr, test_live,
+    test_compact, test_streaming); session scope so the ~70 modules
+    are discovered and AST-parsed once per run, not once per file."""
+    import thinvids_tpu
+    from thinvids_tpu.analysis import SourceTree, default_manifest
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tree = SourceTree(os.path.join(repo, "thinvids_tpu"),
+                      extra_files=(os.path.join(repo, "bench.py"),))
+    return default_manifest(), tree

@@ -11,8 +11,6 @@ rung×shard path, and the jax-free grep guard on ladder.py/hls.py.
 """
 
 import os
-import subprocess
-import sys
 import threading
 import time
 
@@ -657,13 +655,22 @@ class TestRemoteLadder:
 # ---------------------------------------------------------------------------
 
 
-def test_ladder_and_hls_import_without_jax():
+def test_ladder_and_hls_are_manifested_jax_free(analysis_ctx):
     """Packaging and planning must run on jax-free worker/sidecar
-    processes (same rule as parallel/packproc.py): importing the
-    modules must not drag jax in."""
-    code = ("import sys; "
-            "import thinvids_tpu.abr.ladder; "
-            "import thinvids_tpu.abr.hls; "
-            "assert 'jax' not in sys.modules, 'abr pulled jax in'")
-    subprocess.run([sys.executable, "-c", code], check=True,
-                   env=dict(os.environ, PYTHONPATH=REPO), timeout=120)
+    processes (same rule as parallel/packproc.py). Migrated from a
+    subprocess import probe to the analyzer's import-graph proof: the
+    manifest must keep declaring both modules jax-free, and the
+    confinement pass (which walks the TRANSITIVE module-scope import
+    closure, package __init__ chains included) must be clean on HEAD.
+    Tree-wide enforcement rides `cli.py check` in tier-1."""
+    from thinvids_tpu.analysis import imports
+    from thinvids_tpu.analysis.astutil import matches_any
+
+    m, tree = analysis_ctx
+    for mod in ("thinvids_tpu.abr.ladder", "thinvids_tpu.abr.hls"):
+        assert matches_any(mod, m.jax_free), (
+            f"manifest no longer declares {mod} jax-free")
+    open_ = [f for f in imports.check_jax_confinement(tree, m)
+             if f.key not in m.waivers and f.module.startswith(
+                 "thinvids_tpu.abr")]
+    assert not open_, "\n".join(f.format() for f in open_)

@@ -101,6 +101,30 @@ class TestIdleGate:
         agent.tick()
         assert susp == [1]                # once per episode
 
+    def test_suspend_fires_once_under_concurrent_ticks(self):
+        """Regression (cli.py check TVT-T001): tick() is public while
+        _loop ticks on the agent thread — the idle-gate's
+        check-and-set is now atomic under _gate_lock, so a tick storm
+        fires suspend_action exactly once per episode."""
+        import threading
+
+        clock, idle, susp = {"t": 0.0}, {"v": True}, []
+        agent = self._agent(clock, idle, susp)
+        agent.tick()                      # arm the episode
+        clock["t"] = 301.0
+        barrier = threading.Barrier(8)
+
+        def storm():
+            barrier.wait()
+            agent._idle_gate({"cpu": 0.0})
+
+        workers = [threading.Thread(target=storm) for _ in range(8)]
+        for t in workers:
+            t.start()
+        for t in workers:
+            t.join(5)
+        assert susp == [1]
+
     def test_activity_resets_idle_window(self):
         clock, idle, susp = {"t": 0.0}, {"v": True}, []
         agent = self._agent(clock, idle, susp)

@@ -6,11 +6,12 @@ bit-identity of the compact transfer against the validated sparse2 path
 (including the escape-heavy dense-fallback edge), the per-shard
 concurrent fetch on the 8-device virtual mesh, the process pack
 sidecars (pack_backend=process), the stage-honesty accounting
-(dense_retry / dense_fallback_waves / d2h_bytes), and the grep guard
-that keeps blocking `jax.device_get` off the hot path for good.
+(dense_retry / dense_fallback_waves / d2h_bytes), and the sync
+confinement that keeps blocking `jax.device_get` off the hot path for
+good (now enforced tree-wide by `cli.py check`; the test here asserts
+the analyzer manifest still encodes this file's contract).
 """
 
-import inspect
 import os
 import subprocess
 import sys
@@ -322,39 +323,67 @@ class TestProcessPackBackend:
                        timeout=120)
 
 
-class TestNoBlockingDeviceGet:
-    #: modules allowed to call jax.device_get: the wave dispatcher owns
-    #: the boundary (tiny count barriers + the dense retry), tools/ is
-    #: offline utilities, and the two codec entries are the
-    #: single-frame/single-GOP reference paths (encode_intra_jax,
-    #: encoder.encode_gop) that tests and small-clip tools use — none
-    #: of them sit on the wave hot path.
-    ALLOWED = {
-        os.path.join("parallel", "dispatch.py"),
-        os.path.join("codecs", "h264", "jaxcore.py"),
-        os.path.join("codecs", "h264", "encoder.py"),
-    }
+class TestSyncConfinement:
+    """The device_get guard, migrated to the analyzer (tree-wide
+    enforcement lives in `cli.py check` / tests/test_analysis.py; this
+    asserts the manifest still encodes THIS subsystem's contract, so
+    deleting the allowlist entry fails here, next to the code it
+    protects)."""
 
-    def test_no_new_blocking_device_get(self):
-        """CI guard (same style as the read_video guard in
-        tests/test_streaming.py): a blocking `jax.device_get` outside
-        the allowlist reintroduces a serialized fetch on the hot path —
-        route transfers through GopShardEncoder._fetch_bulk instead."""
-        import thinvids_tpu
+    def test_manifest_owns_the_boundary(self):
+        from thinvids_tpu.analysis import default_manifest
+        from thinvids_tpu.analysis.astutil import matches_any
 
-        root = os.path.dirname(inspect.getfile(thinvids_tpu))
-        offenders = []
-        for dirpath, _dirs, files in os.walk(root):
-            for name in files:
-                if not name.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, name)
-                rel = os.path.relpath(path, root)
-                if rel in self.ALLOWED or rel.startswith("tools" + os.sep):
-                    continue
-                with open(path, encoding="utf-8") as fh:
-                    if "device_get" in fh.read():
-                        offenders.append(rel)
-        assert not offenders, (
-            f"blocking device_get outside parallel/dispatch.py and "
-            f"tools/: {offenders}")
+        m = default_manifest()
+        # the wave dispatcher owns the boundary (tiny count barriers +
+        # dense retry); tools/ is offline; the two codec entries are
+        # single-frame/GOP reference paths off the wave hot path
+        for mod in ("thinvids_tpu.parallel.dispatch",
+                    "thinvids_tpu.codecs.h264.jaxcore",
+                    "thinvids_tpu.codecs.h264.encoder",
+                    "thinvids_tpu.tools.oracle"):
+            assert matches_any(mod, m.sync_allowlist), mod
+        assert "device_get" in m.sync_calls
+        assert "block_until_ready" in m.sync_calls
+
+    def test_sync_pass_clean_on_head(self, analysis_ctx):
+        """A blocking `jax.device_get` outside the allowlist
+        reintroduces a serialized fetch on the hot path — route
+        transfers through GopShardEncoder._fetch_bulk instead."""
+        from thinvids_tpu.analysis import syncs
+
+        m, tree = analysis_ctx
+        open_ = [f for f in syncs.run(tree, m)
+                 if f.key not in m.waivers]
+        assert not open_, "\n".join(f.format() for f in open_)
+
+
+class TestProcPoolThreadSafety:
+    def test_disable_proc_pool_single_shot_across_threads(self, caplog):
+        """Regression (cli.py check TVT-T001): several collector
+        threads can hit a broken sidecar pool in the same wave window;
+        the swap-under-_proc_lock retires it exactly once (one warning,
+        no double-disable, never an exception)."""
+        import logging
+        import threading
+
+        enc = object.__new__(GopShardEncoder)
+        enc._proc_lock = threading.Lock()
+        enc._proc_pool = object()
+        barrier = threading.Barrier(8)
+
+        def hit():
+            barrier.wait()
+            enc._disable_proc_pool(RuntimeError("boom"))
+
+        workers = [threading.Thread(target=hit) for _ in range(8)]
+        with caplog.at_level(logging.WARNING,
+                             logger="thinvids_tpu.parallel.dispatch"):
+            for t in workers:
+                t.start()
+            for t in workers:
+                t.join(5)
+        assert enc._proc_pool is None
+        retired = [r for r in caplog.records
+                   if "pack sidecar pool broke" in r.getMessage()]
+        assert len(retired) == 1

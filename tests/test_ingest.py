@@ -90,6 +90,38 @@ class TestWatchIngester:
                             stable_checks=stable_checks)
         return watch, led, ing, calls
 
+    def test_concurrent_scans_submit_once(self, tmp_path):
+        """Regression (cli.py check TVT-T001): run() loops on a
+        watcher thread while scan_once() is public API — scans are now
+        serialized under _scan_lock, so two racing scans over a
+        just-stabilized file submit it exactly once (the second scan
+        starts after the first marked the ledger)."""
+        import threading
+        import time
+
+        calls = []
+
+        def slow_submit(path, state="missing"):
+            time.sleep(0.05)          # widen the race window
+            calls.append(path)
+            return True
+
+        watch, _led, ing, _ = self.make(tmp_path, stable_checks=1,
+                                        submit=slow_submit)
+        make_clip(str(watch / "a.y4m"), n=2)
+        barrier = threading.Barrier(2)
+
+        def scan():
+            barrier.wait()
+            ing.scan_once()
+
+        workers = [threading.Thread(target=scan) for _ in range(2)]
+        for t in workers:
+            t.start()
+        for t in workers:
+            t.join(10)
+        assert len(calls) == 1
+
     def test_unstable_file_deferred_then_submitted(self, tmp_path):
         watch, led, ing, calls = self.make(tmp_path, stable_checks=2)
         clip = watch / "a.y4m"

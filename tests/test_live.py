@@ -16,8 +16,6 @@ plus on-disk GC.
 
 import io
 import os
-import subprocess
-import sys
 import threading
 import time
 
@@ -376,27 +374,19 @@ class TestWatcherLive:
 # ---------------------------------------------------------------------------
 
 
-def test_every_settings_key_has_a_reader_outside_config():
+def test_every_settings_key_has_a_reader_outside_config(analysis_ctx):
     """Dead config lies to operators: every DEFAULT_SETTINGS key must
-    be referenced somewhere outside core/config.py and the tests
-    (executor, planner, API, dashboard, bench, ...)."""
-    sources = []
-    for root, _dirs, files in os.walk(os.path.join(REPO,
-                                                   "thinvids_tpu")):
-        for name in files:
-            if not name.endswith((".py", ".html")):
-                continue
-            if name == "config.py" and root.endswith("core"):
-                continue
-            with open(os.path.join(root, name), encoding="utf-8") as fp:
-                sources.append(fp.read())
-    with open(os.path.join(REPO, "bench.py"), encoding="utf-8") as fp:
-        sources.append(fp.read())
-    blob = "\n".join(sources)
-    dead = sorted(k for k in DEFAULT_SETTINGS if k not in blob)
-    assert not dead, (f"settings keys with no reader outside "
-                      f"core/config.py: {dead} — delete them or wire "
-                      f"them up")
+    be referenced somewhere outside core/config.py (executor, planner,
+    API, dashboard, bench, ...). Promoted from a source-blob grep into
+    the analyzer's config-discipline pass (TVT-C001), which this test
+    now drives directly."""
+    from thinvids_tpu.analysis.configcheck import check_dead_keys
+
+    m, tree = analysis_ctx
+    dead = [f for f in check_dead_keys(tree, m)
+            if f.key not in m.waivers]
+    assert not dead, "\n".join(f.format() for f in dead) + \
+        " — delete them or wire them up"
 
 
 def test_dead_keys_stay_deleted():
@@ -640,18 +630,23 @@ class TestLiveJobEndToEnd:
             assert hls.SEGMENT_PATTERN % 0 not in fp.read()
 
 
-def test_tail_and_packager_import_without_jax():
+def test_tail_and_packager_are_manifested_jax_free(analysis_ctx):
     """ingest/tail.py and live/packager.py are control-plane modules:
     importable (and usable for lint/serving) in a process that never
-    loads a device backend."""
-    code = (
-        "import sys\n"
-        "sys.modules['jax'] = None\n"
-        "sys.modules['jax.numpy'] = None\n"
-        "import thinvids_tpu.ingest.tail\n"
-        "import thinvids_tpu.live.packager\n"
-        "print('ok')\n"
-    )
-    out = subprocess.run([sys.executable, "-c", code], cwd=REPO,
-                         capture_output=True, text=True)
-    assert out.returncode == 0 and "ok" in out.stdout, out.stderr
+    loads a device backend. Migrated from a stubbed-import probe to
+    the analyzer's import-graph proof (manifest declaration + clean
+    confinement pass over the transitive module-scope closure);
+    tree-wide enforcement rides `cli.py check` in tier-1."""
+    from thinvids_tpu.analysis import imports
+    from thinvids_tpu.analysis.astutil import matches_any
+
+    m, tree = analysis_ctx
+    for mod in ("thinvids_tpu.ingest.tail",
+                "thinvids_tpu.live.packager"):
+        assert matches_any(mod, m.jax_free), (
+            f"manifest no longer declares {mod} jax-free")
+    open_ = [f for f in imports.check_jax_confinement(tree, m)
+             if f.key not in m.waivers and f.module in (
+                 "thinvids_tpu.ingest.tail",
+                 "thinvids_tpu.live.packager")]
+    assert not open_, "\n".join(f.format() for f in open_)

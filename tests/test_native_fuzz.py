@@ -1,0 +1,83 @@
+"""Sanitizer-hardened native entropy code (slow).
+
+Runs the corruption/truncation fuzz harness (tools/fuzz_native.py)
+against ASan and UBSan builds of cavlc_pack.cpp
+(``TVT_NATIVE_SANITIZE=asan|ubsan``, native/__init__.py): mutated
+compact payloads through `cavlc_unpack_compact` /
+`cavlc_sparse_unpack2` and hostile level arrays through
+`cavlc_pack_islice16`. A sanitizer report aborts the subprocess, so a
+zero exit IS the memory-safety claim.
+
+Local invocation (also documented in README "Correctness tooling"):
+
+    python -m pytest tests/test_native_fuzz.py -m slow
+    # or directly:
+    TVT_NATIVE_SANITIZE=ubsan python -m thinvids_tpu.tools.fuzz_native
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.slow
+
+
+def _run_fuzz(extra_env: dict, iterations: int = 150) -> None:
+    env = dict(os.environ,
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    env.update(extra_env)
+    proc = subprocess.run(
+        [sys.executable, "-m", "thinvids_tpu.tools.fuzz_native",
+         "--iterations", str(iterations)],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, (
+        f"fuzz harness failed (rc={proc.returncode})\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
+    assert "0 crashes, 0 divergences" in proc.stdout or \
+        "nothing to fuzz" in proc.stdout, proc.stdout
+
+
+def _gxx() -> str | None:
+    return shutil.which("g++")
+
+
+def _runtime(name: str) -> str | None:
+    gxx = _gxx()
+    if gxx is None:
+        return None
+    out = subprocess.run([gxx, f"-print-file-name={name}"],
+                         capture_output=True, text=True)
+    path = out.stdout.strip()
+    return path if os.path.sep in path and os.path.exists(path) else None
+
+
+class TestSanitizedFuzz:
+    def test_ubsan_corpus(self):
+        if _gxx() is None:
+            pytest.skip("no g++")
+        _run_fuzz({"TVT_NATIVE_SANITIZE": "ubsan",
+                   "UBSAN_OPTIONS": "halt_on_error=1:print_stacktrace=1"})
+
+    def test_asan_corpus(self):
+        # the ASan runtime must be in the process before dlopen of the
+        # sanitized .so — preload it (see native/__init__.py docstring)
+        libasan = _runtime("libasan.so")
+        if libasan is None:
+            pytest.skip("no g++ / libasan runtime")
+        _run_fuzz({"TVT_NATIVE_SANITIZE": "asan",
+                   "ASAN_OPTIONS": "detect_leaks=0",
+                   "LD_PRELOAD": libasan})
+
+    def test_production_build_corpus(self):
+        """The same corpus against the production (unsanitized) build:
+        parity + error mapping hold everywhere, not just under
+        instrumentation."""
+        if _gxx() is None:
+            pytest.skip("no g++")
+        _run_fuzz({"TVT_NATIVE_SANITIZE": ""}, iterations=300)

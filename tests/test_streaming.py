@@ -14,7 +14,6 @@
   the list-materializing read_video prologue.
 """
 
-import inspect
 
 import numpy as np
 import pytest
@@ -239,16 +238,22 @@ class TestRemoteShardRange:
 
 
 class TestNoMaterializedPrologue:
-    def test_executor_paths_never_import_read_video(self):
-        """CI guard: the blocking decode prologue must not come back —
-        the executors and the worker daemon stream via open_video;
+    def test_read_video_ban_is_manifested_and_clean(self, analysis_ctx):
+        """The blocking decode prologue must not come back — the
+        executors and the worker daemon stream via open_video;
         read_video (list-materializing) is reserved for small-clip
-        tools (stamping, import, tests)."""
-        import thinvids_tpu.cluster.executor as executor_mod
-        import thinvids_tpu.cluster.remote as remote_mod
+        tools. Migrated from a source grep to the analyzer's
+        forbidden-symbol rule (TVT-J002): this asserts the manifest
+        still bans it for both modules AND that the pass is clean on
+        HEAD (tree-wide enforcement rides `cli.py check` in tier-1)."""
+        from thinvids_tpu.analysis import imports
 
-        for mod in (executor_mod, remote_mod):
-            src = inspect.getsource(mod)
-            assert "read_video" not in src, (
-                f"{mod.__name__} must stream via open_video, not "
-                f"materialize via read_video")
+        m, tree = analysis_ctx
+        for mod in ("thinvids_tpu.cluster.executor",
+                    "thinvids_tpu.cluster.remote"):
+            rules = m.forbidden_symbols.get(mod, ())
+            assert any(sym == "read_video" for sym, _why in rules), (
+                f"manifest no longer bans read_video in {mod}")
+        open_ = [f for f in imports.check_forbidden_symbols(tree, m)
+                 if f.key not in m.waivers]
+        assert not open_, "\n".join(f.format() for f in open_)

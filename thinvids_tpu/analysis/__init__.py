@@ -1,0 +1,59 @@
+"""Repo-native static analysis: machine-checked architecture invariants.
+
+Four passes over the package's ASTs, driven by the declarative
+manifest (analysis/manifest.py) and runnable in <5 s without jax:
+
+1. imports     — jax confinement (TVT-J001) + forbidden symbols
+                 (TVT-J002): declared jax-free modules never reach
+                 `jax` through any module-scope import chain.
+2. syncs       — host-sync confinement (TVT-S001/S002): blocking
+                 device_get / block_until_ready / implicit
+                 np.asarray-on-device syncs stay inside the dispatch
+                 boundary.
+3. threads     — thread-safety audit (TVT-T001/T002/T003): unlocked
+                 cross-entrypoint writes, blocking calls under locks,
+                 lock-order inversions.
+4. configcheck — config discipline (TVT-C001/C002/C003): no dead
+                 settings keys, a registered TVT_* env namespace, no
+                 raw settings subscripts around the clamp tier.
+
+Run via ``python -m thinvids_tpu.cli check`` (tools/check.py); tier-1
+shells out to it (tests/test_analysis.py), replacing the per-file grep
+guards that used to live in four separate test files.
+
+jax-free by contract — and self-hosted: this package is in its own
+manifest's `jax_free` list, so the analyzer analyzes itself.
+"""
+
+from __future__ import annotations
+
+from .astutil import Finding, SourceTree
+from .manifest import Manifest, default_manifest
+
+
+def run_all(tree: SourceTree, manifest: Manifest,
+            defaults: dict | None = None) -> list[Finding]:
+    """Every pass over one source tree; findings in pass order
+    (waivers NOT applied — see apply_waivers)."""
+    from . import configcheck, imports, syncs, threads
+
+    findings: list[Finding] = []
+    findings += imports.run(tree, manifest)
+    findings += syncs.run(tree, manifest)
+    findings += threads.run(tree, manifest)
+    findings += configcheck.run(tree, manifest, defaults)
+    return findings
+
+
+def apply_waivers(findings: list[Finding], manifest: Manifest
+                  ) -> tuple[list[Finding], list[Finding], list[str]]:
+    """(open findings, waived findings, stale waiver keys)."""
+    waived = [f for f in findings if f.key in manifest.waivers]
+    open_ = [f for f in findings if f.key not in manifest.waivers]
+    hit = {f.key for f in waived}
+    stale = sorted(k for k in manifest.waivers if k not in hit)
+    return open_, waived, stale
+
+
+__all__ = ["Finding", "SourceTree", "Manifest", "default_manifest",
+           "run_all", "apply_waivers"]

@@ -1,0 +1,152 @@
+"""Pass 2 — host-sync confinement.
+
+Blocking device→host synchronization (``jax.device_get``,
+``.block_until_ready()``) serializes the wave pipeline: BENCH r04→r05
+showed every device-side win dying at this boundary, and PR 4 spent a
+whole change moving the last stray fetches behind
+``GopShardEncoder._fetch_bulk``. This pass keeps it that way: any call
+of a sync API outside the manifest's allowlist is a finding
+(TVT-S001), generalizing the `device_get` grep that used to live in
+tests/test_compact.py into a real AST check.
+
+It also flags the IMPLICIT syncs a grep can't see (TVT-S002): inside a
+single function, a value produced by a ``jax.*``/``jnp.*`` call that
+is then fed to ``np.asarray`` / ``np.array`` / ``float`` / ``int``
+forces the same blocking transfer without the word "device_get"
+appearing anywhere. The taint tracking is deliberately local (names
+assigned from jax-namespace calls within one function) — cheap, zero
+false positives on host-only numpy code, and exactly the shape the
+historical regressions took (`np.asarray(payload)` on a device array).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .astutil import (Finding, SourceTree, dotted_name, finding,
+                      matches_any)
+from .manifest import Manifest
+
+#: numpy-side consumers that force a device sync when fed a jax value
+_SYNC_SINKS = {"asarray", "array", "ascontiguousarray"}
+_SCALAR_SINKS = {"float", "int"}
+
+
+def _jax_aliases(tree: ast.Module) -> set[str]:
+    """Local names bound to the jax / jax.numpy modules at module
+    scope (`import jax`, `import jax.numpy as jnp`, ...)."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                root = alias.name.split(".")[0]
+                if root == "jax":
+                    out.add(alias.asname or root)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.module.split(".")[0] == "jax":
+                for alias in node.names:
+                    # `from jax import numpy as jnp` binds a module;
+                    # `from jax.sharding import Mesh` binds a class —
+                    # either way calls through it aren't device values
+                    # unless they're jnp.*; keep module-ish names only
+                    if alias.name == "numpy":
+                        out.add(alias.asname or alias.name)
+    return out
+
+
+def _is_jax_call(node: ast.AST, aliases: set[str]) -> bool:
+    """Call whose dotted root is a jax alias (jnp.zeros, jax.jit...)."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    return bool(name) and name.split(".")[0] in aliases
+
+
+def _function_nodes(tree: ast.Module):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def check_sync_calls(tree: SourceTree, manifest: Manifest
+                     ) -> list[Finding]:
+    """Flag ANY reference to a sync API name — attribute access, bare
+    name, or `from jax import device_get as dg` alias — not just
+    direct calls: storing/aliasing the function escapes a call-only
+    check but reintroduces the same serialized fetch (the retired grep
+    matched the substring anywhere; this keeps that strength with AST
+    precision — docstrings and comments no longer count)."""
+    findings: list[Finding] = []
+    for mod in tree.modules():
+        if matches_any(mod, manifest.sync_allowlist):
+            continue
+        for node in ast.walk(tree.tree(mod)):
+            names: list[tuple[str, int]] = []
+            if isinstance(node, ast.Attribute):
+                names.append((node.attr, node.lineno))
+            elif isinstance(node, ast.Name):
+                names.append((node.id, node.lineno))
+            elif isinstance(node, ast.ImportFrom):
+                names.extend((alias.name, node.lineno)
+                             for alias in node.names)
+            for attr, line in names:
+                if attr in manifest.sync_calls:
+                    findings.append(finding(
+                        "TVT-S001", mod, line,
+                        f"blocking device sync `{attr}` referenced "
+                        f"outside the allowlist — route transfers "
+                        f"through GopShardEncoder._fetch_bulk",
+                        key_detail=f"{mod}:{attr}"))
+    uniq: dict[tuple[str, int], Finding] = {}
+    for f in findings:
+        uniq.setdefault((f.key, f.line), f)
+    return list(uniq.values())
+
+
+def check_implicit_syncs(tree: SourceTree, manifest: Manifest
+                         ) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in tree.modules():
+        if matches_any(mod, manifest.sync_allowlist):
+            continue
+        aliases = _jax_aliases(tree.tree(mod))
+        if not aliases:
+            continue                # module can't hold device values
+        for fn in _function_nodes(tree.tree(mod)):
+            tainted: set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and \
+                        _is_jax_call(node.value, aliases):
+                    for tgt in node.targets:
+                        for el in (tgt.elts if isinstance(
+                                tgt, (ast.Tuple, ast.List)) else [tgt]):
+                            if isinstance(el, ast.Name):
+                                tainted.add(el.id)
+            if not tainted:
+                continue
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call) and node.args):
+                    continue
+                func = node.func
+                sink = None
+                if isinstance(func, ast.Attribute) and \
+                        func.attr in _SYNC_SINKS:
+                    sink = func.attr
+                elif isinstance(func, ast.Name) and \
+                        func.id in _SCALAR_SINKS:
+                    sink = func.id
+                if sink is None:
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Name) and arg.id in tainted:
+                    findings.append(finding(
+                        "TVT-S002", mod, node.lineno,
+                        f"`{sink}({arg.id})` forces an implicit device "
+                        f"sync on a jax value in `{fn.name}`",
+                        key_detail=f"{mod}:{fn.name}"))
+    return findings
+
+
+def run(tree: SourceTree, manifest: Manifest) -> list[Finding]:
+    return check_sync_calls(tree, manifest) \
+        + check_implicit_syncs(tree, manifest)

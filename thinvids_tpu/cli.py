@@ -203,6 +203,19 @@ def run_feed(args: argparse.Namespace) -> None:
              args.dest, args.rate if args.rate > 0 else float("inf"))
 
 
+def run_check(args: argparse.Namespace) -> None:
+    """Static analysis over this repo (tools/check.py): jax/sync
+    confinement, thread-safety audit, config discipline. jax-free and
+    fast — tier-1 shells out to it. Delegates to tools.check.main so
+    the documented exit codes (0 clean / 1 findings / 2 internal
+    error) hold from this entry point too."""
+    from .tools.check import main as check_main
+
+    argv = (["--json"] if args.json else []) \
+        + (["--quiet"] if args.quiet else [])
+    raise SystemExit(check_main(argv))
+
+
 def run_agent(args: argparse.Namespace) -> None:
     from .cluster.agent import NodeAgent, http_submitter
     from .core.log import get_logging
@@ -272,6 +285,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pacing as a multiple of real time "
                         "(0 = as fast as possible)")
     f.set_defaults(fn=run_feed)
+
+    k = sub.add_parser("check", help="static analysis: jax/sync "
+                                     "confinement, thread safety, "
+                                     "config discipline")
+    k.add_argument("--json", action="store_true",
+                   help="machine-readable findings")
+    k.add_argument("--quiet", action="store_true",
+                   help="suppress the clean-run summary")
+    k.set_defaults(fn=run_check)
     return p
 
 

@@ -124,6 +124,12 @@ class NodeAgent:
         self._clock = clock
         self._idle_since: float | None = None
         self._suspended_this_episode = False
+        #: guards the idle-episode state: tick() is public (tests and
+        #: embedding code call it directly) while _loop ticks on the
+        #: agent thread — without this the check-and-set on
+        #: _suspended_this_episode can fire suspend_action twice per
+        #: episode (`cli.py check` TVT-T001)
+        self._gate_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.role = "encode"
@@ -153,24 +159,30 @@ class NodeAgent:
 
     def _idle_gate(self, metrics: Mapping[str, Any]) -> None:
         snap = self._settings_fn()
-        if not bool(snap.get("suspend_enabled", False)):
-            self._idle_since = None
-            return
-        cpu_ok = float(metrics.get("cpu", 100.0)) \
-            <= float(snap.get("suspend_cpu_pct", 20.0))
-        idle = cpu_ok and self._idle_probe()
+        idle = False
+        if bool(snap.get("suspend_enabled", False)):
+            cpu_ok = float(metrics.get("cpu", 100.0)) \
+                <= float(snap.get("suspend_cpu_pct", 20.0))
+            idle = cpu_ok and self._idle_probe()
         now = self._clock()
-        if not idle:
-            self._idle_since = None
-            self._suspended_this_episode = False
-            return
-        if self._idle_since is None:
-            self._idle_since = now
-            return
-        if (now - self._idle_since >= float(snap.get("suspend_idle_s", 300))
-                and not self._suspended_this_episode
-                and self._suspend_action is not None):
-            self._suspended_this_episode = True
+        fire = False
+        with self._gate_lock:
+            if not idle:
+                self._idle_since = None
+                self._suspended_this_episode = False
+                return
+            if self._idle_since is None:
+                self._idle_since = now
+                return
+            if (now - self._idle_since
+                    >= float(snap.get("suspend_idle_s", 300))
+                    and not self._suspended_this_episode
+                    and self._suspend_action is not None):
+                self._suspended_this_episode = True
+                fire = True
+        if fire:
+            # outside the lock: the action may suspend the host —
+            # holding the gate across it would stall a concurrent tick
             self._suspend_action()
 
     # -- loop ----------------------------------------------------------

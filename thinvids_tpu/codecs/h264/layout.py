@@ -60,6 +60,11 @@ def split_compact(payload: np.ndarray, nblk: int, nval: int, L: int):
     gives them no alignment guarantee — nb8 may be odd)."""
     NB = -(-L // SPARSE_BLOCK)
     nb8 = (NB + 7) // 8
+    if L <= 0 or nblk < 0 or nval < 0:
+        # fuzz-found (tools/fuzz_native.py): negative slice counts
+        # must reject like the native parser, not quietly shrink the
+        # streams into a zero decode
+        raise ValueError("compact stream counts out of range")
     need = nb8 + 2 * int(nblk) + int(nval)
     payload = np.asarray(payload, np.uint8).reshape(-1)
     if payload.shape[0] < need:
@@ -77,12 +82,39 @@ def block_sparse_unpack2_host(nblk: int, nval: int, bitmap: np.ndarray,
                               bmask16: np.ndarray, vals: np.ndarray,
                               L: int) -> np.ndarray:
     """Numpy inverse of jaxcore._block_sparse_pack2 → flat int16 levels
-    (the native scatter's parity reference; jaxcore re-exports it)."""
+    (the native scatter's parity reference; jaxcore re-exports it).
+    Rejects count/stream disagreement like the native core: corrupt
+    counts must fail loudly, not decode as silent zeros."""
     NB = -(-L // SPARSE_BLOCK)
-    bm = np.unpackbits(np.asarray(bitmap, np.uint8))[:NB].astype(bool)
+    if L <= 0 or nblk < 0 or nval < 0:
+        raise ValueError("sparse stream counts out of range")
+    if nblk > np.asarray(bmask16).reshape(-1).shape[0] \
+            or nval > np.asarray(vals).reshape(-1).shape[0]:
+        raise ValueError("sparse stream counts exceed buffer sizes")
+    nb8 = (NB + 7) // 8
+    bitmap = np.asarray(bitmap, np.uint8).reshape(-1)
+    if bitmap.shape[0] < nb8:
+        # fuzz-found: a truncated bitmap must reject like the native
+        # wrapper's size validation, not decode short
+        raise ValueError("sparse bitmap truncated")
+    bits = np.unpackbits(bitmap[:nb8])
+    if bits[NB:].any():
+        # pack never sets the byte-padding bits past NB; a set one is
+        # a corrupt bitmap (the native core's tail scan rejects it too
+        # — fuzz-found asymmetry, tools/fuzz_native.py)
+        raise ValueError("sparse bitmap padding bits set")
+    bm = bits[:NB].astype(bool)
     masks = np.asarray(bmask16)[:nblk].astype(np.uint32)
     lane_bits = ((masks[:, None] >> np.arange(SPARSE_BLOCK, dtype=np.uint32))
                  & 1).astype(bool)                      # (nblk, 16)
+    # Explicit count agreement, like the native core's bi/vi checks:
+    # numpy's size-1 broadcasting otherwise lets a corrupt nval=1
+    # stream silently replicate one value across every live lane
+    # (fuzz-found, tools/fuzz_native.py)
+    if int(bm.sum()) != int(nblk):
+        raise ValueError("sparse bitmap disagrees with nblk")
+    if int(lane_bits.sum()) != int(nval):
+        raise ValueError("sparse lane masks disagree with nval")
     stream = np.asarray(vals)[:nval].astype(np.int16)
     rows = np.zeros((nblk, SPARSE_BLOCK), np.int16)
     rows[lane_bits] = stream        # row-major = (block, lane) order

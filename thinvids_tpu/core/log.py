@@ -2,7 +2,8 @@
 
 Port of the reference's idempotent shared logger
 (/root/reference/common.py:100-161): one root configuration, format with
-hostname + pid, ``LOG_LEVEL`` env override, noisy third-party loggers quieted.
+hostname + pid, ``TVT_LOG_LEVEL`` env override (legacy ``LOG_LEVEL``
+still honored), noisy third-party loggers quieted.
 """
 
 from __future__ import annotations
@@ -22,7 +23,11 @@ _QUIET = ("urllib3", "watchdog", "jax._src", "absl")
 def get_logging(name: str = "thinvids_tpu") -> logging.Logger:
     global _CONFIGURED
     if not _CONFIGURED:
-        level_name = os.environ.get("LOG_LEVEL", "INFO").upper()
+        # TVT_LOG_LEVEL is the registered knob (analysis/manifest.py);
+        # bare LOG_LEVEL survives as a reference-compat fallback
+        # (waived in the manifest)
+        level_name = os.environ.get(
+            "TVT_LOG_LEVEL", os.environ.get("LOG_LEVEL", "INFO")).upper()
         level = getattr(logging, level_name, logging.INFO)
         handler = logging.StreamHandler()
         handler.setFormatter(

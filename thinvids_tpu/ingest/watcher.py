@@ -170,6 +170,11 @@ class WatchIngester:
         self.stable_checks = max(1, int(stable_checks))
         #: rel_path → (last signature, consecutive identical scans)
         self._stability: dict[str, tuple[str, int]] = {}
+        #: serializes whole scans: run() loops on a watcher thread
+        #: while scan_once() is public API — two interleaved scans
+        #: would double-submit a just-stabilized file between its
+        #: submit and its ledger mark (`cli.py check` TVT-T001)
+        self._scan_lock = threading.Lock()
 
     # -- discovery -----------------------------------------------------
 
@@ -203,7 +208,12 @@ class WatchIngester:
     # -- scanning ------------------------------------------------------
 
     def scan_once(self) -> list[str]:
-        """One discovery pass. Returns the rel paths submitted."""
+        """One discovery pass. Returns the rel paths submitted.
+        Serialized: concurrent calls run one after the other."""
+        with self._scan_lock:
+            return self._scan_once_locked()
+
+    def _scan_once_locked(self) -> list[str]:
         self.ledger.reload_if_changed()
         found = self._discover()
         submitted: list[str] = []

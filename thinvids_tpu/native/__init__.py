@@ -7,6 +7,18 @@ blockwise math stays on the TPU. Falls back to the pure-Python packer
 when no compiler is available (same output bits, tested identical).
 
 Build artifacts go to native/_build/ (gitignored).
+
+Sanitizer builds: ``TVT_NATIVE_SANITIZE=asan|ubsan`` compiles the
+library with AddressSanitizer / UndefinedBehaviorSanitizer (own .so
+name per mode, so sanitized and production artifacts never clobber
+each other). The corruption/truncation fuzz harness
+(tools/fuzz_native.py, tests/test_native_fuzz.py `slow`) drives the
+unpack/pack entry points with mutated compact payloads under these
+builds. NOTE for asan: the ASan runtime must be in the process before
+the .so loads — run ``LD_PRELOAD=$(g++ -print-file-name=libasan.so)
+ASAN_OPTIONS=detect_leaks=0 python ...`` (the harness does this for
+its subprocesses; detect_leaks=0 because CPython's arena allocator is
+not leak-clean).
 """
 
 from __future__ import annotations
@@ -21,7 +33,32 @@ import numpy as np
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "cavlc_pack.cpp")
 _BUILD_DIR = os.path.join(_DIR, "_build")
-_SO = os.path.join(_BUILD_DIR, "cavlc_pack.so")
+
+#: sanitizer build mode, fixed at first build for the process' life
+#: ("" = production; registered in analysis/manifest.py process_env)
+_SANITIZE_MODES = {
+    "": (),
+    "asan": ("-fsanitize=address", "-fno-omit-frame-pointer", "-g"),
+    "ubsan": ("-fsanitize=undefined", "-fno-sanitize-recover=undefined",
+              "-fno-omit-frame-pointer", "-g"),
+}
+
+
+def _sanitize_mode() -> str:
+    mode = os.environ.get("TVT_NATIVE_SANITIZE", "").strip().lower()
+    return mode if mode in _SANITIZE_MODES else ""
+
+
+def _so_path(mode: str) -> str:
+    tag = f".{mode}" if mode else ""
+    return os.path.join(_BUILD_DIR, f"cavlc_pack{tag}.so")
+
+
+#: mode captured ONCE at import: flags and the .so name must come from
+#: the same read, or an env flip between import and first build would
+#: compile sanitized code over the production artifact
+_MODE = _sanitize_mode()
+_SO = _so_path(_MODE)
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -70,10 +107,11 @@ def _build_and_load() -> ctypes.CDLL:
                 # one valid. A shared tmp let builder B keep writing
                 # into the inode builder A had already renamed to _SO.
                 tmp = _SO + f".tmp.{os.getpid()}"
+                flags = list(_SANITIZE_MODES[_MODE])
                 try:
                     subprocess.run(
                         ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                         _SRC, "-o", tmp],
+                         *flags, _SRC, "-o", tmp],
                         check=True, capture_output=True, timeout=120)
                     os.replace(tmp, _SO)
                 finally:
@@ -297,6 +335,15 @@ def block_sparse_unpack2(nblk: int, nval: int, bitmap: np.ndarray,
     bmask16 = np.ascontiguousarray(bmask16, np.uint16)
     vals = np.ascontiguousarray(vals, np.int8)
     NB = -(-L // 16)
+    # Bounds hardening (fuzz-proven under ASan/UBSan,
+    # tools/fuzz_native.py): the C scatter trusts the counts to stay
+    # inside the caller's buffers — corrupt counts from a torn
+    # transfer must fail HERE, not read past the arrays.
+    if L <= 0 or nblk < 0 or nval < 0:
+        raise ValueError("sparse stream counts out of range")
+    if (nblk > bmask16.size or nval > vals.size
+            or bitmap.size < -(-NB // 8)):
+        raise ValueError("sparse stream counts exceed buffer sizes")
     # np.zeros = calloc: the native scatter relies on the buffer being
     # zeroed, and lazy OS zero-pages beat an explicit 50 MB/GOP memset
     out = np.zeros(NB * 16, np.int16)
@@ -318,6 +365,10 @@ def unpack_compact(nblk: int, nval: int, payload: np.ndarray,
     lib = _build_and_load()
     payload = np.ascontiguousarray(payload, np.uint8)
     NB = -(-L // 16)
+    # Bounds hardening to match block_sparse_unpack2 (the C side also
+    # checks payload_len against the counts and returns -2)
+    if L <= 0 or nblk < 0 or nval < 0:
+        raise ValueError("compact stream counts out of range")
     # np.zeros = calloc, same lazy-zero-page contract as above
     out = np.zeros(NB * 16, np.int16)
     rc = lib.cavlc_unpack_compact(

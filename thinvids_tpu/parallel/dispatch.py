@@ -564,6 +564,11 @@ class GopShardEncoder:
             pack_backend = str(snap.get("pack_backend", "thread")
                                or "thread")
         self.pack_backend = str(pack_backend)
+        #: guards _proc_pool: collect_wave runs on one collector thread
+        #: per in-flight wave, and any of them may retire a broken
+        #: sidecar pool (_disable_proc_pool) while the others read it —
+        #: flagged by `cli.py check` (TVT-T001) and locked since
+        self._proc_lock = threading.Lock()
         self._proc_pool = self._new_proc_pool()
         #: one warning per encoder when async D2H prefetch is refused
         #: (a platform where copy_to_host_async silently no-ops must be
@@ -876,9 +881,12 @@ class GopShardEncoder:
     def _disable_proc_pool(self, exc: BaseException) -> None:
         """Runtime degrade: a broken sidecar pool (spawn refused, child
         OOM-killed) must not fail the encode — retire the pool and pack
-        the rest of the job on threads."""
-        if self._proc_pool is not None:
-            self._proc_pool = None
+        the rest of the job on threads. Swap-under-lock: several
+        collector threads can hit the broken pool in the same wave
+        window, and exactly ONE of them must log the retirement."""
+        with self._proc_lock:
+            pool, self._proc_pool = self._proc_pool, None
+        if pool is not None:
             _LOG.warning(
                 "pack sidecar pool broke (%s: %s); packing on threads "
                 "from here on", type(exc).__name__, exc)
@@ -1026,7 +1034,8 @@ class GopShardEncoder:
         # process sidecars take whole GOPs); phase 2 gathers in GOP
         # order.
         pool = self._slice_pool()
-        proc = self._proc_pool if (compact and sparse_ok) else None
+        with self._proc_lock:
+            proc = self._proc_pool if (compact and sparse_ok) else None
         #: live shared-memory spools of this wave's process-pack jobs —
         #: released by each gather(), and swept below if the wave dies
         #: before every gather ran (a leaked block outlives the process)

@@ -1,0 +1,88 @@
+"""`cli.py check` — run the static-analysis passes over this repo.
+
+Fast (one AST parse per file, no jax import) so it rides inside
+tier-1: tests/test_analysis.py shells out to it and fails when the
+tree violates the manifest. Exit codes: 0 clean (waived findings and
+stale waivers print as warnings), 1 open findings, 2 internal error.
+
+Usage:
+    python -m thinvids_tpu.cli check [--json] [--quiet]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="thinvids_tpu check",
+        description="static analysis: jax/sync confinement, thread "
+                    "safety, config discipline")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings on stdout")
+    p.add_argument("--quiet", action="store_true",
+                   help="suppress the clean-run summary")
+    return p
+
+
+def run_check(json_out: bool = False, quiet: bool = False) -> int:
+    from ..analysis import (SourceTree, apply_waivers, default_manifest,
+                            run_all)
+
+    package_dir = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    repo_root = os.path.dirname(package_dir)
+    extra = tuple(
+        p for p in (os.path.join(repo_root, "bench.py"),) if os.path.exists(p))
+    tree = SourceTree(package_dir, extra_files=extra)
+    manifest = default_manifest()
+    findings = run_all(tree, manifest)
+    open_, waived, stale = apply_waivers(findings, manifest)
+    open_.sort(key=lambda f: (f.code, f.module, f.line))
+
+    if json_out:
+        print(json.dumps({
+            "open": [f.__dict__ for f in open_],
+            "waived": [dict(f.__dict__,
+                            reason=manifest.waivers[f.key])
+                       for f in waived],
+            "stale_waivers": stale,
+            "modules_scanned": len(tree.modules()),
+        }, indent=2))
+        return 1 if open_ else 0
+
+    for f in open_:
+        print(f.format())
+    for f in waived:
+        print(f"waived  {f.format()}  [{manifest.waivers[f.key]}]")
+    for key in stale:
+        print(f"warning: stale waiver `{key}` matches no finding — "
+              f"remove it from analysis/manifest.py")
+    if open_:
+        print(f"\n{len(open_)} open finding(s) over "
+              f"{len(tree.modules())} modules — fix them or add a "
+              f"waiver with a reason to analysis/manifest.py")
+        return 1
+    if not quiet:
+        print(f"check clean: {len(tree.modules())} modules, "
+              f"{len(waived)} waived finding(s), "
+              f"{len(stale)} stale waiver(s)")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return run_check(json_out=args.json, quiet=args.quiet)
+    except Exception as exc:    # noqa: BLE001 - tooling must not traceback
+        print(f"check failed: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
+        raise SystemExit(2)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
