@@ -252,93 +252,62 @@ def _run_ladder(w: int, h: int, nframes: int, qp: int, gop_frames: int,
             "h2d_bytes": enc.stages.snapshot().get("h2d_bytes", 0)}
 
 
-def _run_live(w: int, h: int, nframes: int, qp: int, gop_frames: int,
-              rungs_spec: str = "540", segment_s: float = 1.0,
-              dvr_window_s: float = 2.0) -> dict:
-    """Glass-to-playlist latency through the PRODUCTION live pipeline:
-    a writer thread paces y4m frames into a growing `.live.y4m` drop,
-    the real coordinator + executor tail it (`_run_live`), and a
-    poller watches the top rung's media playlist — each announced part
-    yields one latency sample: wall-clock from the part's LAST frame
-    hitting the source file to the part being fetchable.
+def _measure_live_pace(meta, frames, rungs, gop_frames: int, fps: int,
+                       segment_s: float,
+                       warm_full: bool = False) -> tuple[float, float]:
+    """Warm the live wave shapes and measure a sustainable ingest pace.
 
-    The writer paces at the sustainable ingest rate measured by a
-    warmup ladder encode (never above the stream's nominal fps): a
-    live deployment provisions encode >= real time, and on a harness
-    slower than that the metric must measure PIPELINE latency, not
-    unbounded backlog growth — the pacing rate rides along as
-    `ingest_fps` so the context is pinned, not hidden."""
-    import os
-    import statistics
-    import tempfile
-    import threading
+    The executor pins the GOP grid to gop_frames (_live_batch_plan), so
+    warming must use the same pinned plans — the natural planner would
+    compile different, useless shapes. `warm_full` also compiles the
+    full-backlog catch-up wave (needed when the bench's writer can fall
+    behind by more than one GOP).
 
-    from thinvids_tpu.abr.hls import live_playlist_state
-    from thinvids_tpu.abr.ladder import (LadderShardEncoder,
-                                         plan_ladder)
-    from thinvids_tpu.cluster import Coordinator, WorkerRegistry
-    from thinvids_tpu.cluster.executor import LocalExecutor
-    from thinvids_tpu.core.config import DEFAULT_SETTINGS, Settings
-    from thinvids_tpu.core.status import Status
-    from thinvids_tpu.core.types import VideoMeta
-    from thinvids_tpu.io.y4m import Y4MWriter
+    Edge rate: one-GOP waves are the live edge's steady state and on a
+    wide mesh cost a full padded wave — batched catch-up waves amortize
+    better, so the 1-GOP wave rate is the binding constraint on keeping
+    up; pacing at half of it keeps backlog bounded so the metric
+    measures PIPELINE latency, not unbounded backlog growth.
 
-    fps = 30
-    frames = make_frames(nframes, w, h)
-    meta = VideoMeta(width=w, height=h, fps_num=fps, fps_den=1,
-                     num_frames=nframes)
-    snap = Settings(values=dict(
-        DEFAULT_SETTINGS, qp=qp, gop_frames=gop_frames,
-        ladder_rungs=rungs_spec, segment_s=segment_s,
-        dvr_window_s=dvr_window_s, live_stall_s=10.0,
-        heartbeat_throttle_s=0.0))
-    rungs = plan_ladder(meta, snap)
-
-    # warmup: compile the LIVE wave shapes — the executor pins the GOP
-    # grid to gop_frames (_live_batch_plan), so warm with the same
-    # pinned plans (full-backlog batch + the live edge's 1-GOP batch);
-    # the natural planner would compile different, useless shapes —
-    # and measure the sustainable source rate on a compile-free pass
+    The stream's segment duration is provisioned to measured
+    capability, exactly as a live operator does on slower hardware: one
+    GOP's wall-clock encode is the latency floor, so a segment shorter
+    than ~2 GOP-walls would set an impossible latency budget. NOTE:
+    bypasses the live tier's 60 s clamp on purpose; a bench host that
+    slow still gets a correctly-judged (if dismal) number instead of a
+    false fail. Returns (ingest_fps, segment_s)."""
+    from thinvids_tpu.abr.ladder import LadderShardEncoder
     from thinvids_tpu.cluster.executor import _live_batch_plan
 
     warm = LadderShardEncoder(meta, rungs, gop_frames=gop_frames)
-    warm.plan_override = _live_batch_plan(nframes, gop_frames,
-                                          warm.num_devices)
-    warm.encode(frames)
+    if warm_full:
+        warm.plan_override = _live_batch_plan(
+            meta.num_frames, gop_frames, warm.num_devices)
+        warm.encode(frames)
     warm.plan_override = _live_batch_plan(gop_frames, gop_frames,
                                           warm.num_devices)
     warm.encode(frames[:gop_frames])
-    # edge rate: one-GOP waves are the live edge's steady state and on
-    # a wide mesh cost a full padded wave — pace against whichever is
-    # slower, batch throughput or edge cadence, so backlog stays
-    # bounded and the metric measures pipeline latency
     t0 = time.perf_counter()
     warm.encode(frames[:gop_frames])
     edge_fps = gop_frames / (time.perf_counter() - t0)
-    # batched catch-up waves amortize better than the edge cadence, so
-    # the 1-GOP wave rate is the binding constraint on keeping up
     ingest_fps = max(0.5, min(float(fps), 0.5 * edge_fps))
-    # provision the stream's segment duration to measured capability,
-    # exactly as a live operator does on slower hardware: one GOP's
-    # wall-clock encode is the latency floor, so a segment shorter
-    # than ~2 GOP-walls would set an impossible latency budget. The
-    # chosen duration rides along as `live_segment_s` — the latency
-    # metric is judged against the STREAM'S OWN segment duration.
     gop_wall_s = gop_frames / max(edge_fps, 1e-3)
-    segment_s = max(float(segment_s), 2.0 * gop_wall_s)
-    # rebuild the settings snapshot with the provisioned duration —
-    # the executor reads segment_s from here. NOTE: bypasses the live
-    # tier's 60 s clamp on purpose; a bench host that slow still gets
-    # a correctly-judged (if dismal) number instead of a false fail.
-    snap = Settings(values=dict(snap.values, segment_s=segment_s))
+    return ingest_fps, max(float(segment_s), 2.0 * gop_wall_s)
 
-    tmp = tempfile.mkdtemp(prefix="tvt-live-")
-    path = os.path.join(tmp, "bench.live.y4m")
+
+def _start_paced_writer(path: str, meta, frames, ingest_fps: float):
+    """Writer thread pacing y4m frames into a growing `.live` drop,
+    closing the stream with the `.eos` marker. Returns (thread,
+    write_times); write_times[i] is the wall-clock at which frame i
+    finished hitting the source file."""
+    import io as _io
+    import threading
+
+    from thinvids_tpu.io.y4m import Y4MWriter
+
     write_times: list[float] = []
 
     def writer() -> None:
-        import io as _io
-
         buf = _io.BytesIO()
         wtr = Y4MWriter(buf, meta)
         with open(path, "wb") as out:
@@ -358,35 +327,41 @@ def _run_live(w: int, h: int, nframes: int, qp: int, gop_frames: int,
         with open(path + ".eos", "wb"):
             pass
 
-    reg = WorkerRegistry()
-    for i in range(8):
-        reg.heartbeat(f"bench{i}")
-    coord = Coordinator(registry=reg, settings_fn=lambda: snap)
-    execu = LocalExecutor(coord, output_dir=os.path.join(tmp, "lib"),
-                          sync=False)
-    coord._launcher = execu.launch
     wt = threading.Thread(target=writer, daemon=True)
     wt.start()
-    job = coord.add_job(path, meta)
+    return wt, write_times
 
-    # one part = one GOP, so the live edge (next_msn, next_part) maps
-    # exactly to announced source frames: every MID-STREAM closed
-    # segment holds seg_gops whole parts (the greedy segmenter closes
-    # only at the target); only the FINAL segment can be short, so the
-    # cumulative count is capped at the stream's true GOP total
-    # ceil, not round: the greedy segmenter closes at the FIRST GOP
-    # crossing segment_s (epsilon guards exact-multiple float specs)
+
+def _sample_live_edge(coord, job_id: str, media: str, write_times,
+                      *, nframes: int, gop_frames: int, fps: int,
+                      segment_s: float, sample_gate=None):
+    """Poll a live job's top-rung media playlist until the job reaches
+    a terminal state; every newly announced part yields one
+    glass-to-playlist latency sample (wall-clock from the part's LAST
+    frame hitting the source file to the part being fetchable).
+
+    One part = one GOP, so the live edge (next_msn, next_part) maps
+    exactly to announced source frames: every MID-STREAM closed
+    segment holds seg_gops whole parts (the greedy segmenter closes
+    only at the FIRST GOP crossing segment_s — ceil, not round, with
+    an epsilon guarding exact-multiple float specs); only the FINAL
+    segment can be short, so the cumulative count is capped at the
+    stream's true GOP total. `sample_gate` (when given) must be true
+    at announce time for the part to count — the origin bench uses it
+    to keep only parts announced during the viewer-load window.
+    Returns (samples, seen_gops, final_segments)."""
     import math as _math
+
+    from thinvids_tpu.abr.hls import live_playlist_state
+    from thinvids_tpu.core.status import Status
 
     seg_gops = max(1, _math.ceil(segment_s * fps / gop_frames - 1e-9))
     total_gops = -(-nframes // gop_frames)
-    media = os.path.join(tmp, "lib", "bench.live.hls",
-                         rungs[0].name, "media.m3u8")
     samples: list[float] = []
     seen_gops = 0
     final_segments = 0
     while True:
-        st = coord.store.get(job.id)
+        st = coord.store.get(job_id)
         try:
             with open(media, encoding="utf-8") as fp:
                 pl = live_playlist_state(fp.read())
@@ -399,12 +374,81 @@ def _run_live(w: int, h: int, nframes: int, qp: int, gop_frames: int,
                        pl["next_msn"] * seg_gops + pl["next_part"])
             for g in range(seen_gops, gops):
                 last_frame = min((g + 1) * gop_frames, nframes) - 1
-                if last_frame < len(write_times):
+                if last_frame < len(write_times) and (
+                        sample_gate is None or sample_gate()):
                     samples.append(now - write_times[last_frame])
             seen_gops = max(seen_gops, gops)
         if st.status in (Status.DONE, Status.FAILED):
-            break
+            return samples, seen_gops, final_segments
         time.sleep(0.005)
+
+
+def _run_live(w: int, h: int, nframes: int, qp: int, gop_frames: int,
+              rungs_spec: str = "540", segment_s: float = 1.0,
+              dvr_window_s: float = 2.0) -> dict:
+    """Glass-to-playlist latency through the PRODUCTION live pipeline:
+    a writer thread paces y4m frames into a growing `.live.y4m` drop,
+    the real coordinator + executor tail it (`_run_live`), and a
+    poller watches the top rung's media playlist — each announced part
+    yields one latency sample: wall-clock from the part's LAST frame
+    hitting the source file to the part being fetchable.
+
+    The writer paces at the sustainable ingest rate measured by a
+    warmup ladder encode (never above the stream's nominal fps): a
+    live deployment provisions encode >= real time, and on a harness
+    slower than that the metric must measure PIPELINE latency, not
+    unbounded backlog growth — the pacing rate rides along as
+    `ingest_fps` so the context is pinned, not hidden."""
+    import os
+    import statistics
+    import tempfile
+
+    from thinvids_tpu.abr.ladder import plan_ladder
+    from thinvids_tpu.cluster import Coordinator, WorkerRegistry
+    from thinvids_tpu.cluster.executor import LocalExecutor
+    from thinvids_tpu.core.config import DEFAULT_SETTINGS, Settings
+    from thinvids_tpu.core.status import Status
+    from thinvids_tpu.core.types import VideoMeta
+
+    fps = 30
+    frames = make_frames(nframes, w, h)
+    meta = VideoMeta(width=w, height=h, fps_num=fps, fps_den=1,
+                     num_frames=nframes)
+    snap = Settings(values=dict(
+        DEFAULT_SETTINGS, qp=qp, gop_frames=gop_frames,
+        ladder_rungs=rungs_spec, segment_s=segment_s,
+        dvr_window_s=dvr_window_s, live_stall_s=10.0,
+        heartbeat_throttle_s=0.0))
+    rungs = plan_ladder(meta, snap)
+
+    # warm the pinned live wave shapes (full backlog + 1-GOP edge) and
+    # provision pace + segment duration to measured capability; the
+    # chosen duration rides along as `live_segment_s` — the latency
+    # metric is judged against the STREAM'S OWN segment duration
+    ingest_fps, segment_s = _measure_live_pace(
+        meta, frames, rungs, gop_frames, fps, segment_s, warm_full=True)
+    # rebuild the settings snapshot with the provisioned duration —
+    # the executor reads segment_s from here
+    snap = Settings(values=dict(snap.values, segment_s=segment_s))
+
+    tmp = tempfile.mkdtemp(prefix="tvt-live-")
+    path = os.path.join(tmp, "bench.live.y4m")
+
+    reg = WorkerRegistry()
+    for i in range(8):
+        reg.heartbeat(f"bench{i}")
+    coord = Coordinator(registry=reg, settings_fn=lambda: snap)
+    execu = LocalExecutor(coord, output_dir=os.path.join(tmp, "lib"),
+                          sync=False)
+    coord._launcher = execu.launch
+    wt, write_times = _start_paced_writer(path, meta, frames, ingest_fps)
+    job = coord.add_job(path, meta)
+
+    media = os.path.join(tmp, "lib", "bench.live.hls",
+                         rungs[0].name, "media.m3u8")
+    samples, seen_gops, final_segments = _sample_live_edge(
+        coord, job.id, media, write_times, nframes=nframes,
+        gop_frames=gop_frames, fps=fps, segment_s=segment_s)
     wt.join()
     execu.join(5)
     st = coord.store.get(job.id)
@@ -426,10 +470,143 @@ def _run_live(w: int, h: int, nframes: int, qp: int, gop_frames: int,
     }
 
 
+def _run_origin(w: int, h: int, nframes: int, qp: int, gop_frames: int,
+                sessions: int | None = None,
+                duration_s: float | None = None,
+                rungs_spec: str = "120") -> dict:
+    """Origin-at-scale figures through the PRODUCTION serving stack:
+    a real coordinator + HTTP API serve (1) a finished ladder job's
+    VOD tree and (2) a live job being encoded from a paced writer,
+    while `tools/loadgen.py` replays N concurrent player sessions
+    against the VOD program. Emits `sessions_sustained` (sessions
+    that ran the whole window error-free), measured per-segment fetch
+    latency percentiles, and `live_latency_under_load_s` — the live
+    stream's glass-to-playlist latency WHILE the origin carries the
+    viewer load (the number a CDN-fronted deployment actually cares
+    about). Session count / window default to the `loadgen_sessions` /
+    `loadgen_duration_s` settings."""
+    import os
+    import shutil
+    import statistics
+    import tempfile
+    import threading
+
+    from thinvids_tpu.abr.ladder import plan_ladder
+    from thinvids_tpu.api.server import ApiServer
+    from thinvids_tpu.cluster import Coordinator, WorkerRegistry
+    from thinvids_tpu.cluster.executor import LocalExecutor
+    from thinvids_tpu.core.config import DEFAULT_SETTINGS, Settings
+    from thinvids_tpu.core.status import Status
+    from thinvids_tpu.core.types import VideoMeta
+    from thinvids_tpu.io.y4m import write_y4m
+    from thinvids_tpu.tools import loadgen
+
+    snap_defaults = Settings(values=dict(DEFAULT_SETTINGS))
+    sessions = int(snap_defaults.get("loadgen_sessions", 500)) \
+        if sessions is None else sessions
+    duration_s = float(snap_defaults.get("loadgen_duration_s", 10.0)) \
+        if duration_s is None else duration_s
+
+    fps = 30
+    frames = make_frames(nframes, w, h)
+    meta = VideoMeta(width=w, height=h, fps_num=fps, fps_den=1,
+                     num_frames=nframes)
+    tmp = tempfile.mkdtemp(prefix="tvt-origin-")
+    try:
+        # -- measure a sustainable live pace (same rationale as
+        # _run_live: the metric is pipeline latency, not backlog)
+        snap = Settings(values=dict(
+            DEFAULT_SETTINGS, qp=qp, gop_frames=gop_frames,
+            ladder_rungs=rungs_spec, segment_s=0.5, dvr_window_s=0.0,
+            live_stall_s=10.0, heartbeat_throttle_s=0.0))
+        rungs = plan_ladder(meta, snap)
+        ingest_fps, segment_s = _measure_live_pace(
+            meta, frames, rungs, gop_frames, fps, 0.5)
+        snap = Settings(values=dict(snap.values, segment_s=segment_s))
+
+        reg = WorkerRegistry()
+        for i in range(8):
+            reg.heartbeat(f"origin{i}")
+        coord = Coordinator(registry=reg, settings_fn=lambda: snap)
+        execu = LocalExecutor(coord, output_dir=os.path.join(tmp, "lib"),
+                              sync=False)
+        coord._launcher = execu.launch
+        api = ApiServer(coord).start()
+        try:
+            # -- (1) VOD program: a tiny ladder job, encoded to DONE
+            vod_src = os.path.join(tmp, "vod.ladder.y4m")
+            write_y4m(vod_src, meta, frames)
+            vod = coord.add_job(vod_src, meta)
+            deadline = time.monotonic() + 600
+            while coord.store.get(vod.id).status not in (Status.DONE,
+                                                         Status.FAILED):
+                if time.monotonic() > deadline:
+                    raise RuntimeError("VOD ladder job never finished")
+                time.sleep(0.05)
+            if coord.store.get(vod.id).status is not Status.DONE:
+                raise RuntimeError("VOD ladder job failed: "
+                                   + coord.store.get(vod.id).failure_reason)
+
+            # -- (2) live job: paced writer into a growing drop
+            live_path = os.path.join(tmp, "cam.live.y4m")
+            wt, write_times = _start_paced_writer(live_path, meta,
+                                                  frames, ingest_fps)
+            live_job = coord.add_job(live_path, meta)
+
+            # -- (3) viewer load against the VOD program while the
+            # live job encodes; loadgen runs in a thread so this
+            # thread can sample the live edge under load
+            load_out: dict = {}
+
+            def load() -> None:
+                load_out.update(loadgen.run_load(
+                    api.url, vod.id, sessions=sessions,
+                    duration_s=duration_s))
+
+            lt = threading.Thread(target=load, daemon=True)
+            lt.start()
+
+            media = os.path.join(tmp, "lib", "cam.live.hls",
+                                 rungs[0].name, "media.m3u8")
+            # only parts announced DURING the viewer load window count
+            # toward the under-load latency metric
+            samples, _, _ = _sample_live_edge(
+                coord, live_job.id, media, write_times,
+                nframes=nframes, gop_frames=gop_frames, fps=fps,
+                segment_s=segment_s, sample_gate=lt.is_alive)
+            wt.join(30)
+            lt.join(duration_s + 120)
+            execu.join(30)
+            st = coord.store.get(live_job.id)
+            if st.status is not Status.DONE:
+                raise RuntimeError(
+                    f"live job under load ended {st.status.value}: "
+                    f"{st.failure_reason}")
+            origin_snap = api.origin.snapshot()
+        finally:
+            api.stop()
+        return {
+            "sessions": load_out.get("sessions", sessions),
+            "sessions_sustained": load_out.get("sessions_sustained", 0),
+            "p50_segment_ms": load_out.get("segment_ms_p50", 0.0),
+            "p99_segment_ms": load_out.get("segment_ms_p99", 0.0),
+            "requests": load_out.get("requests", 0),
+            "errors": load_out.get("errors", 0),
+            "live_latency_under_load_s": (
+                round(statistics.median(samples), 3) if samples else -1.0),
+            "origin_hits": origin_snap.get("origin_hits", 0),
+            "origin_bytes": origin_snap.get("origin_bytes", 0),
+            "duration_s": duration_s,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def build_result(r1080: dict, r4k: dict, *, platform: str, qp: int,
                  gop: int, n_1080: int, cold: dict | None = None,
                  ladder: dict | None = None,
-                 live: dict | None = None) -> dict:
+                 live: dict | None = None,
+                 origin: dict | None = None) -> dict:
     """Assemble the one-line BENCH JSON from the two resolutions' runs
     (kept separate from main() so tests can assert the schema — e.g.
     the `stage_ms` breakdown and the `fps_cold_1080p` cold figure — on
@@ -475,6 +652,17 @@ def build_result(r1080: dict, r4k: dict, *, platform: str, qp: int,
         out["live_dvr_segments"] = live["dvr_segments"]
         out["live_segment_s"] = live["segment_s"]
         out["live_ingest_fps"] = live["ingest_fps"]
+    if origin is not None:
+        # origin-at-scale: concurrent HLS player sessions the origin
+        # sustained error-free over the load window, MEASURED segment
+        # fetch latency percentiles, and the live pipeline's
+        # glass-to-playlist latency while carrying that viewer load
+        out["origin_sessions_sustained"] = origin["sessions_sustained"]
+        out["origin_p99_segment_ms"] = origin["p99_segment_ms"]
+        out["origin_p50_segment_ms"] = origin["p50_segment_ms"]
+        out["origin_requests"] = origin["requests"]
+        out["live_latency_under_load_s"] = \
+            origin["live_latency_under_load_s"]
     return out
 
 
@@ -502,6 +690,13 @@ def main() -> None:
     # live job (48 frames = 6 GOP parts = 3 media segments).
     r_live = _run_live(1920, 1080, 48, qp, gop)
 
+    # Origin at scale: N concurrent player sessions (loadgen_sessions,
+    # default 500) replayed against a served VOD ladder while a live
+    # job encodes — serving happens over HTTP, so the program content
+    # stays small and the measured quantity is the ORIGIN, not the
+    # encoder.
+    r_origin = _run_origin(320, 180, 48, qp, gop)
+
     # 4K rides with quality ON (psnr_y_2160p/ssim_y_2160p): 16 frames
     # keeps the untimed oracle decode affordable.
     n_4k = 16
@@ -509,7 +704,8 @@ def main() -> None:
 
     print(json.dumps(build_result(r1080, r4k, platform=platform, qp=qp,
                                   gop=gop, n_1080=n_1080, cold=r_cold,
-                                  ladder=r_ladder, live=r_live)))
+                                  ladder=r_ladder, live=r_live,
+                                  origin=r_origin)))
 
 
 if __name__ == "__main__":

@@ -9,6 +9,7 @@ regression fails fast instead of at round scoring.
 """
 
 import bench
+import pytest
 
 
 def test_run_pipeline_reports_stage_breakdown():
@@ -55,9 +56,15 @@ def test_bench_result_schema_includes_stage_ms():
     live = {"latency_s": 0.41, "latency_p99_s": 0.62,
             "dvr_segments": 2, "segment_s": 1.0, "ingest_fps": 12.5,
             "gops": 6}
+    origin = {"sessions": 500, "sessions_sustained": 498,
+              "p50_segment_ms": 2.1, "p99_segment_ms": 14.7,
+              "requests": 120000, "errors": 2,
+              "live_latency_under_load_s": 0.9,
+              "origin_hits": 90000, "origin_bytes": 1 << 30,
+              "duration_s": 10.0}
     result = bench.build_result(r, r4k, platform="cpu", qp=27, gop=8,
                                 n_1080=64, cold=cold, ladder=ladder,
-                                live=live)
+                                live=live, origin=origin)
     assert result["value"] == 33.3
     assert result["fps_2160p"] == 2.8
     assert set(STAGE_NAMES) <= set(result["stage_ms"])
@@ -90,6 +97,13 @@ def test_bench_result_schema_includes_stage_ms():
     assert result["live_dvr_segments"] == 2
     assert result["live_segment_s"] == 1.0
     assert result["live_ingest_fps"] == 12.5
+    # origin-at-scale: sustained concurrent HLS sessions + MEASURED
+    # segment-latency percentiles + live latency under viewer load
+    assert result["origin_sessions_sustained"] == 498
+    assert result["origin_p99_segment_ms"] == 14.7
+    assert result["origin_p50_segment_ms"] == 2.1
+    assert result["origin_requests"] == 120000
+    assert result["live_latency_under_load_s"] == 0.9
 
 
 def test_run_live_reports_glass_to_playlist_latency():
@@ -104,6 +118,25 @@ def test_run_live_reports_glass_to_playlist_latency():
     assert r["dvr_segments"] >= 1
     assert r["gops"] >= 4
     assert r["ingest_fps"] > 0
+
+
+@pytest.mark.slow
+def test_run_origin_serves_mixed_load():
+    """The origin bench drives the PRODUCTION serving stack (real
+    coordinator + HTTP API + loadgen player sessions over a served VOD
+    ladder while a live job encodes) and reports sustained sessions +
+    measured latency. Small here — 24 sessions, tiny frames — so the
+    harness itself is exercised; the driver's run uses the
+    loadgen_sessions default (500)."""
+    r = bench._run_origin(64, 48, nframes=16, qp=27, gop_frames=4,
+                          sessions=24, duration_s=3.0,
+                          rungs_spec="24")
+    assert r["sessions"] == 24
+    assert r["sessions_sustained"] >= 20
+    assert r["p99_segment_ms"] >= r["p50_segment_ms"] > 0
+    assert r["live_latency_under_load_s"] > 0
+    assert r["requests"] > 0 and r["errors"] <= 2
+    assert r["origin_hits"] > 0        # hot segments came from memory
 
 
 def test_run_ladder_reports_aggregate_and_shared_upload():

@@ -49,6 +49,11 @@ class Manifest:
         "thinvids_tpu.codecs.h264.layout",
         "thinvids_tpu.io",              # whole package
         "thinvids_tpu.ingest.tail",
+        # the origin serving layer and its load harness run on the
+        # coordinator's API threads / a client box — never on a mesh
+        "thinvids_tpu.origin",          # whole package
+        "thinvids_tpu.tools.loadgen",
+        "thinvids_tpu.cluster.qos",
         # self-hosting: the analyzer itself runs inside tier-1 as a
         # fast jax-free subprocess
         "thinvids_tpu.analysis",

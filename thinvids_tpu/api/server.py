@@ -45,14 +45,27 @@ from ..cluster.jobs import Job
 
 
 class ApiError(Exception):
-    def __init__(self, status: int, message: str) -> None:
+    def __init__(self, status: int, message: str,
+                 headers: dict[str, str] | None = None) -> None:
         super().__init__(message)
         self.status = status
         self.message = message
+        #: extra response headers (e.g. Retry-After on a 503)
+        self.headers = dict(headers or {})
 
 
-def _job_view(job: Job) -> dict[str, Any]:
-    return job.to_dict()
+def _job_view(job: Job, cluster_priority: str = "auto") -> dict[str, Any]:
+    from ..cluster.qos import job_class
+
+    d = job.to_dict()
+    # QoS class the scheduler/board will treat the job as (the
+    # dashboard surfaces it next to the job type) — resolved the same
+    # way Coordinator._job_rank does: per-job override first, then the
+    # cluster-wide `job_priority` setting
+    d["priority"] = job_class(
+        getattr(job, "job_type", "transcode"),
+        str(job.settings.get("job_priority", cluster_priority) or "auto"))
+    return d
 
 
 # Scalar, orderable Job fields (sorting by meta/settings or mixing types
@@ -64,16 +77,22 @@ _SORTABLE = {f.name for f in dataclasses.fields(Job)
 
 
 class _FileResponse:
-    """Handler payload sentinel: stream a file instead of JSON (the
+    """Handler payload sentinel: serve a file instead of JSON (the
     reference's send_file preview, manager/app.py:2402-2460).
     `headers` are extra response headers (Cache-Control for the HLS
-    routes — a CDN in front of the origin keys on these)."""
+    routes — a CDN in front of the origin keys on these). `plan` is
+    the resolved origin serve plan (origin/serve.py: status 200/206/
+    304/416, ETag + range headers, and either an in-memory body from
+    the hot-segment cache or a disk window to stream); when None the
+    file streams whole with a plain 200 (legacy callers)."""
 
     def __init__(self, path: str, content_type: str,
-                 headers: dict[str, str] | None = None) -> None:
+                 headers: dict[str, str] | None = None,
+                 plan=None) -> None:
         self.path = path
         self.content_type = content_type
         self.headers = dict(headers or {})
+        self.plan = plan
 
 
 class ApiServer:
@@ -88,27 +107,44 @@ class ApiServer:
                  port: int = 0,
                  browse_roots: dict[str, str] | None = None,
                  work=None) -> None:
+        from ..origin.serve import Origin
+
         self.coordinator = coordinator
         self.browse_roots = dict(browse_roots or {})
         #: optional ShardBoard (cluster/remote.py): when attached, the
         #: /work/* routes serve the worker-daemon pull API and
         #: /metrics_snapshot carries the farm's shard stats
         self.work = work
+        #: origin serving state (origin/): hot-segment cache, request
+        #: counters, per-job session gauges, bounded reload waiters
+        self.origin = Origin(coordinator._settings_fn)
         api = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 keep-alive: a player session holds ONE server
+            # thread for its whole visit instead of one thread (and a
+            # TCP handshake) per request — every reply path sets
+            # Content-Length, which keep-alive requires. Idle
+            # connections are reaped by the socket timeout.
+            protocol_version = "HTTP/1.1"
+            timeout = 60
+
             # quiet request logging (the reference silenced werkzeug,
             # /root/reference/common.py:151-161)
             def log_message(self, *args: Any) -> None:
                 pass
 
-            def _reply(self, status: int, payload: Any) -> None:
+            def _reply(self, status: int, payload: Any,
+                       headers: dict[str, str] | None = None) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for key, value in (headers or {}).items():
+                    self.send_header(key, value)
                 self.end_headers()
-                self.wfile.write(body)
+                if self.command != "HEAD":
+                    self.wfile.write(body)
 
             def _body(self) -> dict[str, Any]:
                 length = int(self.headers.get("Content-Length") or 0)
@@ -133,27 +169,69 @@ class ApiServer:
                 self.send_header("Content-Type", "text/html; charset=utf-8")
                 self.send_header("Content-Length", str(len(content)))
                 self.end_headers()
-                self.wfile.write(content)
+                if self.command != "HEAD":
+                    self.wfile.write(content)
 
             def _reply_file(self, fr: _FileResponse) -> None:
-                # open BEFORE sending headers: a vanished file must 404,
-                # not corrupt an already-started 200 stream
+                plan = fr.plan
+                head = self.command == "HEAD"
+                if plan is not None and plan.body is not None:
+                    # resolved in-memory body (hot-cache hit, 304, 416)
+                    self.send_response(plan.status)
+                    self.send_header("Content-Type", fr.content_type)
+                    if plan.status != 304:
+                        self.send_header("Content-Length",
+                                         str(plan.length))
+                    for hdrs in (fr.headers, plan.headers):
+                        for key, value in hdrs.items():
+                            self.send_header(key, value)
+                    self.end_headers()
+                    if not head and plan.status not in (304, 416):
+                        try:
+                            self.wfile.write(plan.body)
+                        except OSError:
+                            # partial write: the byte stream is short of
+                            # its declared Content-Length, so the
+                            # keep-alive connection is unusable
+                            self.close_connection = True
+                    return
+                # stream a (possibly ranged) disk window in chunks —
+                # open BEFORE sending headers: a vanished file must
+                # 404, not corrupt an already-started 200 stream
                 fp = open(fr.path, "rb")
                 try:
-                    size = os.fstat(fp.fileno()).st_size
-                    self.send_response(200)
+                    if plan is not None:
+                        status, offset = plan.status, plan.offset
+                        length = plan.length
+                        extra = plan.headers
+                    else:
+                        status, offset, extra = 200, 0, {}
+                        length = os.fstat(fp.fileno()).st_size
+                    self.send_response(status)
                     self.send_header("Content-Type", fr.content_type)
-                    self.send_header("Content-Length", str(size))
-                    for key, value in fr.headers.items():
-                        self.send_header(key, value)
+                    self.send_header("Content-Length", str(length))
+                    for hdrs in (fr.headers, extra):
+                        for key, value in hdrs.items():
+                            self.send_header(key, value)
                     self.end_headers()
+                    if head:
+                        return
+                    fp.seek(offset)
+                    left = length
                     try:
-                        while True:
-                            chunk = fp.read(1 << 20)
+                        while left > 0:
+                            chunk = fp.read(min(1 << 20, left))
                             if not chunk:
                                 break
+                            left -= len(chunk)
                             self.wfile.write(chunk)
+                        if left > 0:
+                            # file shrank under us: the byte stream is
+                            # short of its declared Content-Length, so
+                            # the keep-alive connection is unusable
+                            self.close_connection = True
                     except OSError:
+                        self.close_connection = True
                         return          # client went away mid-stream;
                                         # never append a second response
                 finally:
@@ -169,8 +247,15 @@ class ApiServer:
                         self._reply_html(ui.index_html())
                         return
                     body = self._body() if method in ("POST", "PUT") else {}
+                    # request context for the origin routes: conditional
+                    # / range headers + the client's session identity
+                    ctx = {
+                        "method": self.command,
+                        "headers": self.headers,
+                        "client": "%s:%s" % self.client_address[:2],
+                    }
                     status, payload = api.route(method, url.path, query,
-                                                body)
+                                                body, ctx=ctx)
                     if isinstance(payload, _FileResponse):
                         try:
                             self._reply_file(payload)
@@ -179,13 +264,21 @@ class ApiServer:
                         return
                     self._reply(status, payload)
                 except ApiError as exc:
-                    self._reply(exc.status, {"error": exc.message})
+                    self._reply(exc.status, {"error": exc.message},
+                                headers=exc.headers)
                 except (KeyError, ValueError) as exc:
                     self._reply(400, {"error": str(exc)})
                 except Exception as exc:    # noqa: BLE001 - surface, don't die
                     self._reply(500, {"error": f"{type(exc).__name__}: {exc}"})
 
             def do_GET(self) -> None:
+                self._dispatch("GET")
+
+            def do_HEAD(self) -> None:
+                # HEAD dispatches as GET (self.command stays "HEAD", so
+                # replies send headers — incl. Content-Length — without
+                # a body): players and CDNs probe /hls and /result
+                # resources without downloading them
                 self._dispatch("GET")
 
             def do_POST(self) -> None:
@@ -252,19 +345,28 @@ class ApiServer:
         ("POST", r"^/settings$", "post_settings"),
         ("GET", r"^/browse/list$", "browse_list"),
         ("GET", r"^/preview/(?P<job_id>[\w-]+)$", "preview"),
+        ("GET", r"^/result/(?P<job_id>[\w-]+)$", "result"),
         ("GET", r"^/hls/(?P<job_id>[\w-]+)/(?P<rel>.+)$", "hls"),
         ("POST", r"^/stamp_job/(?P<job_id>[\w-]+)$", "stamp_job"),
     ]
 
+    #: handlers that take the request context (conditional/range
+    #: headers, client identity) — the origin-served file routes
+    _CTX_ROUTES = frozenset({"hls", "preview", "result"})
+
     def route(self, method: str, path: str, query: dict[str, str],
-              body: dict[str, Any]) -> tuple[int, Any]:
+              body: dict[str, Any],
+              ctx: dict[str, Any] | None = None) -> tuple[int, Any]:
         for meth, pattern, name in self._ROUTES:
             if meth != method:
                 continue
             m = re.match(pattern, path)
             if m:
                 handler = getattr(self, f"_h_{name}")
-                return handler(query=query, body=body, **m.groupdict())
+                kwargs = dict(query=query, body=body, **m.groupdict())
+                if name in self._CTX_ROUTES:
+                    kwargs["ctx"] = ctx
+                return handler(**kwargs)
         raise ApiError(404, f"no route {method} {path}")
 
     def _get_job(self, job_id: str) -> Job:
@@ -272,6 +374,13 @@ class ApiServer:
         if job is None:
             raise ApiError(404, f"no job {job_id}")
         return job
+
+    def _cluster_priority(self) -> str:
+        return str(self.coordinator._settings_fn().get(
+            "job_priority", "auto") or "auto")
+
+    def _view(self, job: Job) -> dict[str, Any]:
+        return _job_view(job, self._cluster_priority())
 
     # -- handlers ------------------------------------------------------
 
@@ -302,8 +411,9 @@ class ApiServer:
         page_size = min(500, max(1, int(query.get("page_size", 50))))
         start = (page - 1) * page_size
         window = jobs[start:start + page_size]
+        cluster = self._cluster_priority()
         return 200, {
-            "jobs": [_job_view(j) for j in window],
+            "jobs": [_job_view(j, cluster) for j in window],
             "total": len(jobs),
             "page": page,
             "page_size": page_size,
@@ -326,21 +436,21 @@ class ApiServer:
         job = self.coordinator.add_job(
             input_path, meta, settings=body.get("settings"),
             auto_start=body.get("auto_start"), job_type=job_type)
-        return 201, _job_view(job)
+        return 201, self._view(job)
 
     def _h_start_job(self, query, body, job_id) -> tuple[int, Any]:
         self._get_job(job_id)
         job = self.coordinator.queue_job(job_id)
         self.coordinator.dispatch_next_waiting_job()
-        return 200, _job_view(self.coordinator.store.get(job.id))
+        return 200, self._view(self.coordinator.store.get(job.id))
 
     def _h_stop_job(self, query, body, job_id) -> tuple[int, Any]:
         self._get_job(job_id)
-        return 200, _job_view(self.coordinator.stop_job(job_id))
+        return 200, self._view(self.coordinator.stop_job(job_id))
 
     def _h_restart_job(self, query, body, job_id) -> tuple[int, Any]:
         self._get_job(job_id)
-        return 200, _job_view(self.coordinator.restart_job(job_id))
+        return 200, self._view(self.coordinator.restart_job(job_id))
 
     def _h_delete_job(self, query, body, job_id) -> tuple[int, Any]:
         self._get_job(job_id)
@@ -351,7 +461,7 @@ class ApiServer:
         job = self._get_job(job_id)
         lines = self.coordinator.activity.fetch_job(
             job_id, limit=int(query.get("limit", 100)))
-        return 200, {"job": _job_view(job), "activity": lines}
+        return 200, {"job": self._view(job), "activity": lines}
 
     def _h_get_job_settings(self, query, body, job_id) -> tuple[int, Any]:
         job = self._get_job(job_id)
@@ -468,8 +578,12 @@ class ApiServer:
                      "path": "" if rel_out == "." else rel_out,
                      "entries": entries}
 
-    def _h_preview(self, query, body, job_id) -> tuple[int, Any]:
-        """Stream a DONE job's output file (reference /preview/<id>)."""
+    def _h_preview(self, query, body, job_id, ctx=None) -> tuple[int, Any]:
+        """Stream a DONE job's output file (reference /preview/<id>).
+        Supports HEAD and single-range requests (a seeking player
+        probes, then range-reads) via the origin serve planner."""
+        from ..origin.serve import plan_file
+
         job = self._get_job(job_id)
         if job.job_type in ("ladder", "live"):
             # these jobs' output_path is a playlist, not a previewable
@@ -479,7 +593,23 @@ class ApiServer:
                 f"{job.job_type} job: tune to /hls/{job_id}/master.m3u8")
         if not job.output_path or not os.path.exists(job.output_path):
             raise ApiError(404, "job has no output file")
-        return 200, _FileResponse(job.output_path, "video/mp4")
+        ctx = ctx or {}
+        try:
+            # output MP4s are whole-job-sized: never through the hot
+            # cache (cache=None), always chunk-streamed from disk
+            plan = plan_file(job.output_path,
+                             method=str(ctx.get("method", "GET")),
+                             req_headers=ctx.get("headers"),
+                             stats=self.origin.stats)
+        except OSError:
+            raise ApiError(404, "job has no output file")
+        return 200, _FileResponse(job.output_path, "video/mp4",
+                                  plan=plan)
+
+    def _h_result(self, query, body, job_id, ctx=None) -> tuple[int, Any]:
+        """Alias of /preview for tooling: download (or HEAD-probe) a
+        job's result file."""
+        return self._h_preview(query, body, job_id, ctx=ctx)
 
     #: content types the HLS route serves, by extension
     _HLS_TYPES = {
@@ -488,7 +618,7 @@ class ApiServer:
         ".m4s": "video/iso.segment",
     }
 
-    def _h_hls(self, query, body, job_id, rel) -> tuple[int, Any]:
+    def _h_hls(self, query, body, job_id, rel, ctx=None) -> tuple[int, Any]:
         """Serve a ladder/live job's HLS tree: master/media playlists,
         init segments, and fMP4 fragments — `/hls/<job>/master.m3u8`
         is what a player tunes to, and the playlists' relative URIs
@@ -504,7 +634,18 @@ class ApiServer:
         blocking playlist reload is supported on media playlists via
         the standard `_HLS_msn` / `_HLS_part` query params: the
         response is held until the playlist's live edge reaches the
-        requested (msn, part) or the hold budget expires."""
+        requested (msn, part) or the hold budget expires — with the
+        concurrent waiters per job capped (`origin_max_waiters`;
+        beyond the cap: 503 + Retry-After, so a dead stream cannot
+        pin unbounded server threads).
+
+        Segments and init boxes serve through the origin's in-memory
+        hot cache (bounded LRU, single-flight fill) with strong
+        ETags; `If-None-Match` revalidation → 304 and single-range
+        requests → 206 on every resource. Playlists never cache —
+        they rewrite in place every part."""
+        from ..origin.serve import plan_file
+
         job = self._get_job(job_id)
         if job.job_type not in ("ladder", "live"):
             raise ApiError(404, f"job {job_id} is not an HLS job")
@@ -519,11 +660,19 @@ class ApiServer:
         ctype = self._HLS_TYPES.get(ext)
         if ctype is None:
             raise ApiError(404, f"not an HLS resource: {rel}")
+        ctx = ctx or {}
+        req_headers = ctx.get("headers") or {}
+        session = req_headers.get("X-Tvt-Session") \
+            or ctx.get("client") or ""
+        if session:
+            self.origin.sessions.record(job_id, str(session))
         live_open = job.job_type == "live" \
             and job.status is not Status.DONE
+        cacheable = False
         if ext == ".m3u8":
             if "_HLS_msn" in query:
-                self._block_for_playlist_edge(target, query, live_open)
+                self._block_for_playlist_edge(target, query, live_open,
+                                              job_id=job_id)
             # live playlists rewrite after every part — a cached copy
             # is stale within one part duration; finished VOD
             # playlists are stable but kept revalidatable
@@ -532,12 +681,23 @@ class ApiServer:
         else:
             # segments, parts and init are immutable once written
             # (new content always gets a NEW uri) — let a CDN keep
-            # them for as long as it likes
+            # them for as long as it likes, and serve the hot set
+            # from memory here
             headers = {"Cache-Control":
                        "public, max-age=31536000, immutable"}
+            cacheable = True
         if not os.path.isfile(target):
             raise ApiError(404, f"no such HLS file {rel!r}")
-        return 200, _FileResponse(target, ctype, headers=headers)
+        try:
+            plan = plan_file(
+                target, method=str(ctx.get("method", "GET")),
+                req_headers=req_headers,
+                cache=self.origin.cache if cacheable else None,
+                stats=self.origin.stats)
+        except OSError:
+            raise ApiError(404, f"no such HLS file {rel!r}")
+        return 200, _FileResponse(target, ctype, headers=headers,
+                                  plan=plan)
 
     #: cap on one blocking playlist reload (seconds); the spec wants
     #: blocking requests answered as soon as the edge advances, and a
@@ -545,15 +705,20 @@ class ApiServer:
     _BLOCK_RELOAD_MAX_S = 15.0
 
     def _block_for_playlist_edge(self, path: str, query: dict[str, str],
-                                 live_open: bool) -> None:
+                                 live_open: bool,
+                                 job_id: str = "") -> None:
         """LL-HLS blocking playlist reload (RFC 8216bis §6.2.5.2):
         hold the response until the media playlist contains media
         sequence number `_HLS_msn` (and, if given, part `_HLS_part` of
         it), the stream ends, or the hold budget expires — whichever
         comes first. Non-live playlists return immediately (their edge
-        never moves)."""
-        from ..abr.hls import live_playlist_state
+        never moves).
 
+        The hold rides the origin's shared edge watcher (one disk
+        poller per playlist regardless of waiter count) and the
+        per-job waiter cap: past `origin_max_waiters` the request is
+        refused with 503 + Retry-After instead of pinning yet another
+        server thread on a stream that may never advance."""
         try:
             want_msn = int(query["_HLS_msn"])
             raw_part = query.get("_HLS_part")
@@ -565,22 +730,18 @@ class ApiServer:
             raise ApiError(400, "_HLS_msn/_HLS_part must be integers")
         if want_msn < 0 or not live_open:
             return
-        import time as _time
-
-        deadline = _time.monotonic() + self._BLOCK_RELOAD_MAX_S
-        while _time.monotonic() < deadline:
-            try:
-                with open(path, encoding="utf-8") as fp:
-                    st = live_playlist_state(fp.read())
-            except OSError:
-                st = None
-            if st is not None:
-                if st["ended"] or want_msn < st["next_msn"]:
-                    return
-                if want_part is not None and want_msn == st["next_msn"] \
-                        and want_part < st["next_part"]:
-                    return
-            _time.sleep(0.02)
+        origin = self.origin
+        if not origin.gate.try_enter(job_id):
+            origin.stats.bump("origin_503s")
+            raise ApiError(
+                503, "too many blocked playlist reloads for this job; "
+                     "retry shortly",
+                headers={"Retry-After": "1"})
+        try:
+            origin.watcher.wait_edge(path, want_msn, want_part,
+                                     self._BLOCK_RELOAD_MAX_S)
+        finally:
+            origin.gate.leave(job_id)
 
     def _h_stamp_job(self, query, body, job_id) -> tuple[int, Any]:
         """Create a frame-index-watermarked copy of the job's source and
@@ -673,6 +834,12 @@ class ApiServer:
         out["stage_ms"] = disp.stage_snapshot() if disp is not None else {}
         if self.work is not None:
             out["work"] = self.work.snapshot()
+        # origin serving counters + per-job concurrent-session gauges
+        # (origin/serve.py) and the QoS controller's preemption state
+        out["origin"] = self.origin.snapshot()
+        qos = getattr(self.coordinator, "qos", None)
+        if qos is not None:
+            out["qos"] = qos.snapshot()
         return 200, out
 
     # -- worker pull API (cluster/remote.py ShardBoard) ----------------

@@ -40,6 +40,7 @@ from ..core.status import Status
 from ..core.types import VideoMeta
 from .jobs import Job, JobStore, new_run_token
 from .policy import evaluate_job_policy
+from .qos import QosController, job_rank
 
 
 def natural_key(host: str) -> tuple:
@@ -169,6 +170,10 @@ class Coordinator:
         self._settings_fn = settings_fn
         self._sched_lock = threading.RLock()
         self._active_ids: set[str] = set()
+        #: QoS state: priority classes + live deadline preemption
+        #: (cluster/qos.py). Executors report live part latency here;
+        #: the ShardBoard and local wave loops read the batch gate.
+        self.qos = QosController()
 
     # ---- job registration / lifecycle --------------------------------
 
@@ -241,6 +246,7 @@ class Coordinator:
         job = self.store.update(job_id, apply)
         with self._sched_lock:
             self._active_ids.discard(job_id)
+        self.qos.clear_live(job_id)
         self.activity.emit("stop", "stopped by operator", job_id=job_id)
         return job
 
@@ -303,6 +309,7 @@ class Coordinator:
     def delete_job(self, job_id: str) -> bool:
         with self._sched_lock:
             self._active_ids.discard(job_id)
+        self.qos.clear_live(job_id)
         self.activity.drop_job(job_id)
         return self.store.delete(job_id)
 
@@ -361,6 +368,28 @@ class Coordinator:
         self.store.update(job_id, apply)
         return True
 
+    def note_live_part(self, job_id: str, token: str, latency_s: float,
+                       budget_s: float) -> bool:
+        """Live executor's per-part deadline report (token-fenced like
+        every executor callback): latency over budget preempts batch
+        work via the QoS controller; recovery reopens the gate after
+        `live_recover_parts` consecutive good parts."""
+        if not self.token_is_current(job_id, token):
+            return False
+        recover = int(self._settings_fn().get("live_recover_parts", 2))
+        event = self.qos.note_live_part(job_id, latency_s, budget_s,
+                                        recover_parts=recover)
+        if event == "breach":
+            self.activity.emit(
+                "qos", f"live part {latency_s:.2f}s over its "
+                f"{budget_s:.2f}s budget — preempting batch work",
+                job_id=job_id)
+        elif event == "recovered":
+            self.activity.emit(
+                "qos", "live edge recovered — batch work resumes",
+                job_id=job_id)
+        return True
+
     def publish_output(self, job_id: str, token: str,
                        output_path: str) -> bool:
         """Announce a job's output location while it is STILL RUNNING —
@@ -392,6 +421,7 @@ class Coordinator:
         self.store.update(job_id, apply)
         with self._sched_lock:
             self._active_ids.discard(job_id)
+        self.qos.clear_live(job_id)
         self.activity.emit("finish", f"done → {output_path}", job_id=job_id)
         self.dispatch_next_waiting_job()
         return True
@@ -418,6 +448,7 @@ class Coordinator:
         self.store.update(job_id, apply)
         with self._sched_lock:
             self._active_ids.discard(job_id)
+        self.qos.clear_live(job_id)
         self.activity.emit("error", f"failed in {stage}: {reason}",
                            job_id=job_id, host=host)
 
@@ -466,10 +497,29 @@ class Coordinator:
             devices = 0
         return 1 + max(0, devices)
 
+    def _job_rank(self, job: Job, snap: Settings | None = None) -> int:
+        """Priority rank (live=0 > ladder=1 > batch=2) from the job's
+        type, overridable per job / cluster via `job_priority`."""
+        snap = self._settings_fn() if snap is None else snap
+        override = str(job.settings.get(
+            "job_priority", snap.get("job_priority", "auto")) or "auto")
+        return job_rank(getattr(job, "job_type", "transcode"), override)
+
     def _can_dispatch_locked(self, active: list[Job], snap: Settings,
-                             now: float) -> tuple[bool, str]:
+                             now: float, rank: int = 2
+                             ) -> tuple[bool, str]:
+        """The per-class admission gate (the reference's capacity gate
+        generalized: SURVEY §2.3). `rank` is the candidate's priority
+        class — live-class candidates (rank 0) skip the politeness
+        checks (neighbor shareability, pipeline-slot and idle-worker
+        headroom) that exist to protect batch throughput: a live
+        stream's viewers are waiting NOW, and the deadline-preemption
+        path reclaims capacity from batch work if admission oversells.
+        The hard max_active_jobs cap binds every class."""
         if len(active) >= snap.effective_max_active_jobs():
             return False, "max active jobs reached"
+        if rank <= 0:
+            return True, ""
         drain = float(snap.drain_ratio)
         for job in active:
             if not self._job_is_shareable(job, drain):
@@ -487,20 +537,24 @@ class Coordinator:
         return True, ""
 
     def dispatch_next_waiting_job(self) -> Job | None:
-        """One scheduler pass: reserve the oldest WAITING job when the
-        capacity gate passes, then launch it outside the lock
+        """One scheduler pass: reserve the best WAITING job — highest
+        priority class first (live > ladder > batch, cluster/qos.py),
+        oldest within a class — when its class's admission gate
+        passes, then launch it outside the lock
         (/root/reference/manager/app.py:1296-1310)."""
         now = self._clock()
         snap = self._settings_fn()
         with self._sched_lock:
             active = self._active_jobs_locked()
-            ok, _why = self._can_dispatch_locked(active, snap, now)
-            if not ok:
-                return None
             waiting = self.store.list(Status.WAITING)
             if not waiting:
                 return None
-            chosen = min(waiting, key=lambda j: j.queued_at or j.created_at)
+            chosen = min(waiting, key=lambda j: (
+                self._job_rank(j, snap), j.queued_at or j.created_at))
+            ok, _why = self._can_dispatch_locked(
+                active, snap, now, rank=self._job_rank(chosen, snap))
+            if not ok:
+                return None
             token = new_run_token()
 
             def reserve(j: Job) -> None:

@@ -353,12 +353,18 @@ class LocalExecutor:
         # glass-to-playlist latency must not pay the compile (tens of
         # seconds on a real TPU). One dummy wave, output discarded.
         self._warm_live_shapes(enc, meta, gop_n)
+        # QoS deadline: a live batch slower than this budget preempts
+        # batch work on the cluster until the edge recovers
+        # (cluster/qos.py). 0 = auto: 2x the stream's segment duration.
+        part_budget = float(settings.get("live_part_budget_s", 0.0)) \
+            or 2.0 * float(settings.get("segment_s", 6.0))
         wave_cap = enc.num_devices * enc.gops_per_wave
         frames_done = gops_done = 0
         published = False
         while True:
             avail = tail.wait_frames(frames_done + gop_n,
                                      stop_check=fenced)
+            batch_t0 = time.monotonic()
             if fenced():
                 raise HaltedError("stale run token")
             if avail <= frames_done and tail.ended:
@@ -398,6 +404,11 @@ class LocalExecutor:
                 published = True
             gops_done += len(bundles)
             frames_done += count
+            # deadline report: wall-clock from the batch's frames being
+            # available to its parts being fetchable — over budget,
+            # the coordinator preempts batch shards (cluster/qos.py)
+            co.note_live_part(job.id, token,
+                              time.monotonic() - batch_t0, part_budget)
             co.update_progress(job.id, token, parts_total=gops_done,
                                parts_done=gops_done,
                                segment_progress=100.0)
@@ -557,6 +568,30 @@ class LocalExecutor:
                     job_id=job.id, host=self.host)
                 enc = shrunk
 
+    def _qos_pause(self, job: Job, token: str, settings) -> None:
+        """Hold a BATCH-class job's wave loop while the QoS controller
+        has batch work preempted for a struggling live edge
+        (cluster/qos.py): in-flight waves drain, no new wave
+        dispatches, heartbeats keep the watchdog off. Ladder and live
+        jobs never pause; re-raises HaltedError if fenced mid-pause."""
+        from .qos import BATCH_RANK, job_rank
+
+        co = self.coordinator
+        qos = getattr(co, "qos", None)
+        if qos is None or qos.batch_allowed():
+            return
+        override = str(settings.get("job_priority", "auto") or "auto")
+        if job_rank(getattr(job, "job_type", "transcode"),
+                    override) < BATCH_RANK:
+            return
+        co.activity.emit("qos", "batch waves paused: live QoS "
+                         "preemption", job_id=job.id, host=self.host)
+        while not qos.wait_batch_allowed(0.1):
+            if not co.token_is_current(job.id, token):
+                raise HaltedError("stale run token")
+            co.heartbeat_job(job.id, token, "encode", host=self.host,
+                             note="paused: live QoS preemption")
+
     def _shrink_encoder(self, enc, settings, attempt: int):
         """Encoder over a shrunken copy of enc's mesh, or None when it
         cannot shrink further (or the encoder exposes no mesh).
@@ -639,6 +674,7 @@ class LocalExecutor:
             dispatch_next()
             while pending:
                 halt_check()
+                self._qos_pause(job, token, settings)
                 if len(pending) < 2:
                     dispatch_next()     # overlap: depth-2 window, no more
                 i, staged, handle = pending.popleft()

@@ -144,6 +144,10 @@ class Shard:
     rung: str = ""
     rung_width: int = 0
     rung_height: int = 0
+    # QoS class rank (cluster/qos.py: live=0 > ladder=1 > batch=2):
+    # claims hand out the best class first, and batch-rank shards are
+    # requeued/eligibility-gated while a live job is over deadline
+    priority: int = 2
     state: ShardState = ShardState.PENDING
     attempt: int = 0                # completed (failed) attempts so far
     not_before: float = 0.0         # backoff gate for re-claims
@@ -221,6 +225,8 @@ class ShardBoard:
         self._order: list[str] = []     # shard ids in dispatch order
         #: ring of recent shard completions for the dashboard
         self._recent: list[dict[str, Any]] = []
+        #: lifetime QoS preemptions (ASSIGNED batch shards requeued)
+        self._preempted = 0
 
     # -- job lifecycle (RemoteExecutor) --------------------------------
 
@@ -333,10 +339,23 @@ class ShardBoard:
             if s.state is ShardState.PENDING and now >= s.not_before)
         return pending > encode_workers
 
+    def _batch_gated_locked(self) -> bool:
+        """True while the QoS controller has batch work preempted for
+        a live job over its part deadline (cluster/qos.py)."""
+        from .qos import QosController
+
+        qos: QosController | None = getattr(self.coordinator, "qos", None)
+        return qos is not None and not qos.batch_allowed()
+
     def claim(self, host: str) -> dict[str, Any] | None:
-        """Lease the oldest eligible PENDING shard to `host`; None when
-        no work (or the host may not take any). A claim doubles as a
-        liveness heartbeat — a worker that can ask for work is alive."""
+        """Lease the best eligible PENDING shard to `host` — highest
+        QoS class first (live > ladder > batch), oldest within a
+        class; batch-rank shards are withheld entirely while a live
+        job is over its deadline. None when no work (or the host may
+        not take any). A claim doubles as a liveness heartbeat — a
+        worker that can ask for work is alive."""
+        from .qos import BATCH_RANK
+
         host = (host or "").strip()
         if not host:
             return None
@@ -345,16 +364,25 @@ class ShardBoard:
         with self._lock:
             if not self._worker_eligible_locked(host, now):
                 return None
-            for sid in self._order:
+            batch_gated = self._batch_gated_locked()
+            best: Shard | None = None
+            best_key: tuple[int, int] | None = None
+            for pos, sid in enumerate(self._order):
                 shard = self._find_locked(sid)
                 if (shard is None or shard.state is not ShardState.PENDING
                         or now < shard.not_before):
                     continue
-                shard.state = ShardState.ASSIGNED
-                shard.assigned_host = host
-                shard.assigned_at = now
-                shard.deadline_at = now + shard.timeout_s
-                return shard.descriptor()
+                if batch_gated and shard.priority >= BATCH_RANK:
+                    continue
+                key = (shard.priority, pos)
+                if best_key is None or key < best_key:
+                    best, best_key = shard, key
+            if best is not None:
+                best.state = ShardState.ASSIGNED
+                best.assigned_host = host
+                best.assigned_at = now
+                best.deadline_at = now + best.timeout_s
+                return best.descriptor()
         return None
 
     def submit_part(self, shard_id: str, host: str,
@@ -470,6 +498,36 @@ class ShardBoard:
             self.report_failure(sid, host, why)
         return [sid for sid, _h, _w in expired]
 
+    def preempt_batch(self) -> int:
+        """QoS preemption (cluster/qos.py): requeue every ASSIGNED
+        batch-rank shard so its worker frees up for the struggling
+        live edge. NOT a failure — no attempt is burned, no backoff,
+        no quarantine accounting; the preempted worker's late part is
+        still accepted while the shard is open (first result wins,
+        deterministic encode), so no work is wasted either. Returns
+        how many shards were requeued."""
+        from .qos import BATCH_RANK
+
+        requeued: list[tuple[str, str]] = []
+        with self._lock:
+            for entry in self._jobs.values():
+                for shard in entry.shards.values():
+                    if shard.state is not ShardState.ASSIGNED \
+                            or shard.priority < BATCH_RANK:
+                        continue
+                    shard.state = ShardState.PENDING
+                    host = shard.assigned_host
+                    shard.assigned_host = ""
+                    shard.not_before = 0.0
+                    requeued.append((shard.id, host))
+                    self._preempted += 1
+        for sid, host in requeued:
+            self.coordinator.activity.emit(
+                "qos-preempt",
+                f"batch shard {sid} requeued off {host or 'unknown'} "
+                f"(live deadline breach)", host=host)
+        return len(requeued)
+
     def _find_locked(self, shard_id: str) -> Shard | None:
         for entry in self._jobs.values():
             shard = entry.shards.get(shard_id)
@@ -492,6 +550,7 @@ class ShardBoard:
                     counts[shard.state.value] += 1
                     jc[shard.state.value] += 1
             recent = list(self._recent)
+            preempted = self._preempted
         workers = {}
         for w in self.coordinator.registry.all():
             if w.shards_done or w.shards_failed:
@@ -506,7 +565,7 @@ class ShardBoard:
                 "shards_done": 0, "shards_failed": 0, "quarantined": False})
             stats.setdefault("last_shard_s", rec["elapsed_s"])
         return {"shards": counts, "jobs": per_job, "workers": workers,
-                "recent": recent[-20:]}
+                "recent": recent[-20:], "preempted": preempted}
 
 
 class RemoteExecutor(LocalExecutor):
@@ -539,6 +598,11 @@ class RemoteExecutor(LocalExecutor):
         self._clock = clock
         self.poll_s = poll_s if poll_s is not None else self.POLL_S
         self.board = ShardBoard(coordinator, clock=clock)
+        # live deadline breach → requeue this board's ASSIGNED batch
+        # shards (cluster/qos.py fires the hook outside its lock)
+        qos = getattr(coordinator, "qos", None)
+        if qos is not None:
+            qos.on_preempt(self.board.preempt_batch)
 
     # -- shard planning ------------------------------------------------
 
@@ -570,6 +634,8 @@ class RemoteExecutor(LocalExecutor):
         (abr.ladder.Rung) the shards are tagged for that rendition —
         same GOP ranges as every other rung, so the rendition set stays
         boundary-aligned no matter which workers encode which rungs."""
+        from .qos import job_rank
+
         workers = self._live_workers()
         per_shard = int(settings.get("remote_shard_gops", 0))
         if per_shard <= 0:
@@ -579,6 +645,9 @@ class RemoteExecutor(LocalExecutor):
         shards = []
         base_timeout = float(settings.remote_shard_timeout_s)
         tag = f"{rung.name}-" if rung is not None else ""
+        priority = job_rank(
+            getattr(job, "job_type", "transcode"),
+            str(settings.get("job_priority", "auto") or "auto"))
         for i in range(0, plan.num_gops, per_shard):
             gops = plan.gops[i:i + per_shard]
             shards.append(Shard(
@@ -593,7 +662,8 @@ class RemoteExecutor(LocalExecutor):
                 timeout_s=base_timeout * len(gops),
                 rung=rung.name if rung is not None else "",
                 rung_width=rung.width if rung is not None else 0,
-                rung_height=rung.height if rung is not None else 0))
+                rung_height=rung.height if rung is not None else 0,
+                priority=priority))
         return shards
 
     def _build_shards(self, job: Job, meta, num_frames: int,

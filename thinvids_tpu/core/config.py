@@ -77,6 +77,27 @@ DEFAULT_SETTINGS: dict[str, Any] = {
     # duration is one GOP (gop_frames / fps) by construction.
     "live_stall_s": 10.0,
     "dvr_window_s": 0.0,
+    # origin serving + QoS (origin/, cluster/qos.py): hot-segment
+    # cache budget in bytes (TVT_ORIGIN_CACHE_BYTES; 0 disables the
+    # cache), the per-job cap on concurrent LL-HLS blocking-reload
+    # waiters (TVT_ORIGIN_MAX_WAITERS; beyond it the API answers 503 +
+    # Retry-After instead of pinning server threads), the job priority
+    # class override (TVT_JOB_PRIORITY / per-job setting; auto derives
+    # live > ladder > batch from the job type), and the live deadline
+    # machinery: a live part slower than live_part_budget_s
+    # (TVT_LIVE_PART_BUDGET_S; 0 = 2x the stream's segment duration)
+    # preempts batch shards until live_recover_parts consecutive parts
+    # land back inside budget (TVT_LIVE_RECOVER_PARTS).
+    "origin_cache_bytes": 64 * 1024 * 1024,
+    "origin_max_waiters": 64,
+    "job_priority": "auto",          # auto | live | ladder | batch
+    "live_part_budget_s": 0.0,
+    "live_recover_parts": 2,
+    # load harness defaults (tools/loadgen.py + bench.py's origin run):
+    # concurrent player sessions (TVT_LOADGEN_SESSIONS) and the load
+    # window in seconds (TVT_LOADGEN_DURATION_S)
+    "loadgen_sessions": 500,
+    "loadgen_duration_s": 10.0,
     "profile_dir": "",               # non-empty: jax.profiler trace of
                                      # the encode stage lands here
     # host wave pipeline (parallel/dispatch.py): slice-granular CAVLC
@@ -203,6 +224,18 @@ _CLAMPS: dict[str, Callable[[Any], Any]] = {
     # is ~42 ms; 0.5 s is the practical minimum stall)
     "live_stall_s": lambda v: min(3600.0, max(0.5, as_float(v, 10.0))),
     "dvr_window_s": lambda v: min(86400.0, max(0.0, as_float(v, 0.0))),
+    "origin_cache_bytes": lambda v: min(8 << 30, max(
+        0, as_int(v, 64 * 1024 * 1024))),
+    # floor of 1: a zero cap would 503 every blocking reload, which is
+    # indistinguishable from a broken origin to a player
+    "origin_max_waiters": lambda v: min(100_000, max(1, as_int(v, 64))),
+    "job_priority": lambda v: str(v)
+    if str(v) in ("auto", "live", "ladder", "batch")
+    else "auto",
+    "live_part_budget_s": lambda v: min(600.0, max(0.0, as_float(v, 0.0))),
+    "live_recover_parts": lambda v: min(100, max(1, as_int(v, 2))),
+    "loadgen_sessions": lambda v: min(100_000, max(1, as_int(v, 500))),
+    "loadgen_duration_s": lambda v: min(3600.0, max(0.5, as_float(v, 10.0))),
     "pack_workers": lambda v: min(256, max(0, as_int(v, 0))),
     "pipeline_window": lambda v: min(64, max(1, as_int(v, 4))),
     "pack_backend": lambda v: str(v)
@@ -343,7 +376,8 @@ def reset_live_settings() -> None:
 JOB_SETTING_KEYS = frozenset(
     {"gop_frames", "qp", "rc_mode", "target_bitrate_kbps",
      "max_segments", "profile_dir", "ladder_rungs", "segment_s",
-     "live_stall_s", "dvr_window_s"}
+     "live_stall_s", "dvr_window_s", "job_priority",
+     "live_part_budget_s"}
 )
 
 
