@@ -32,6 +32,14 @@ overlap of ingest with device compute is measured, not assumed. Its
 per-stage breakdown (including the new `decode`/`stage` keys) rides as
 `stage_ms_cold`.
 
+`sfe_latency_ms_2160p` / `sfe_fps_2160p` are the split-frame-encoding
+single-stream figures: every 4K frame sharded across the mesh as MB-row
+band slices (one device per band, per-frame dispatch/collect —
+parallel/dispatch.SfeShardEncoder), latency = the steady-state gap
+between consecutive frames' bitstream-ready times. `fps_2160p` reports
+the better of the GOP-wave and SFE paths (`fps_2160p_path` names the
+winner).
+
 `live_latency_s` / `live_latency_p99_s` are the live LL-HLS pipeline's
 glass-to-playlist latency (wall-clock from a frame landing in the
 growing source file to its part being fetchable from the playlist)
@@ -152,6 +160,64 @@ def _run_pipeline(w: int, h: int, nframes: int, qp: int, gop_frames: int,
         "bytes": len(stream),
         "stage_ms": stage_ms,
         "quality": _quality(frames, stream) if quality else {},
+    }
+
+
+def _run_sfe(w: int, h: int, nframes: int, qp: int, gop_frames: int,
+             bands: int = 0, runs: int = 3) -> dict:
+    """Split-frame encoding single-stream figures: e2e fps plus
+    per-frame glass-to-bitstream latency percentiles through the
+    production SFE path (every frame sharded across the mesh as MB-row
+    band slices, per-frame dispatch/collect —
+    parallel/dispatch.SfeShardEncoder). The latency samples are the
+    steady-state gaps between consecutive frames' bitstream-ready
+    timestamps: at the live edge a frame entering the (device step →
+    band fetch → band-slice pack) pipeline exits one such gap later.
+    `bands=0` uses every local device (one band each)."""
+    import statistics
+
+    import jax
+
+    from thinvids_tpu.core.types import VideoMeta, concat_segments
+    from thinvids_tpu.parallel.dispatch import SfeShardEncoder
+
+    frames = make_frames(nframes, w, h)
+    meta = VideoMeta(width=w, height=h, fps_num=30, fps_den=1,
+                     num_frames=nframes)
+    enc = SfeShardEncoder(meta, qp=qp, gop_frames=gop_frames, bands=bands)
+    _, waves = enc.prepare_waves(frames)
+    jax.block_until_ready([wv[1] for wv in waves])    # force HBM staging
+
+    # Warmup compiles BOTH per-frame step programs (intra + P); unlike
+    # the GOP-wave path there is no tail-shape recompile — every frame
+    # runs the same two shapes.
+    concat_segments(enc.encode_waves(waves[:1]))
+
+    t_best = float("inf")
+    lat: list[float] = []
+    stage_ms: dict = {}
+    stream = b""
+    for _ in range(runs):
+        enc.stages.reset()
+        enc.frame_done_t.clear()
+        t0 = time.perf_counter()
+        segs = enc.encode_waves(waves)
+        stream = concat_segments(segs)
+        t = time.perf_counter() - t0
+        if t < t_best:
+            t_best = t
+            lat = enc.frame_latencies_ms()
+            stage_ms = enc.stages.snapshot()
+    lat_sorted = sorted(lat) or [0.0]
+    return {
+        "fps": nframes / t_best,
+        "latency_ms_p50": round(statistics.median(lat_sorted), 1),
+        "latency_ms_p99": round(
+            lat_sorted[int(0.99 * (len(lat_sorted) - 1))], 1),
+        "bands": enc.num_bands,
+        "halo_rows": enc.halo_rows,
+        "bytes": len(stream),
+        "stage_ms": stage_ms,
     }
 
 
@@ -606,7 +672,8 @@ def build_result(r1080: dict, r4k: dict, *, platform: str, qp: int,
                  gop: int, n_1080: int, cold: dict | None = None,
                  ladder: dict | None = None,
                  live: dict | None = None,
-                 origin: dict | None = None) -> dict:
+                 origin: dict | None = None,
+                 sfe: dict | None = None) -> dict:
     """Assemble the one-line BENCH JSON from the two resolutions' runs
     (kept separate from main() so tests can assert the schema — e.g.
     the `stage_ms` breakdown and the `fps_cold_1080p` cold figure — on
@@ -652,6 +719,23 @@ def build_result(r1080: dict, r4k: dict, *, platform: str, qp: int,
         out["live_dvr_segments"] = live["dvr_segments"]
         out["live_segment_s"] = live["segment_s"]
         out["live_ingest_fps"] = live["ingest_fps"]
+    if sfe is not None:
+        # split-frame encoding: the single-stream 4K line. Latency is
+        # the per-frame glass-to-bitstream pipeline gap (p50/p99 over
+        # the run's steady-state frames); fps_2160p reports the BEST
+        # single-stream path and names which one won, so the headline
+        # can only improve when SFE engages (sfe_bands devices > 1)
+        # and stays honest on a single chip.
+        out["sfe_fps_2160p"] = round(sfe["fps"], 2)
+        out["sfe_latency_ms_2160p"] = sfe["latency_ms_p50"]
+        out["sfe_latency_p99_ms_2160p"] = sfe["latency_ms_p99"]
+        out["sfe_bands"] = sfe["bands"]
+        out["sfe_halo_rows"] = sfe["halo_rows"]
+        if sfe["fps"] > r4k["fps"]:
+            out["fps_2160p"] = round(sfe["fps"], 2)
+            out["fps_2160p_path"] = "sfe"
+        else:
+            out["fps_2160p_path"] = "gop_wave"
     if origin is not None:
         # origin-at-scale: concurrent HLS player sessions the origin
         # sustained error-free over the load window, MEASURED segment
@@ -702,10 +786,15 @@ def main() -> None:
     n_4k = 16
     r4k = _run_pipeline(3840, 2160, n_4k, qp, gop, quality=True)
 
+    # Split-frame encoding: the 4K SINGLE-STREAM line — per-frame
+    # glass-to-bitstream latency + fps with every frame sharded across
+    # the mesh as band slices (one band per local device).
+    r_sfe = _run_sfe(3840, 2160, n_4k, qp, gop)
+
     print(json.dumps(build_result(r1080, r4k, platform=platform, qp=qp,
                                   gop=gop, n_1080=n_1080, cold=r_cold,
                                   ladder=r_ladder, live=r_live,
-                                  origin=r_origin)))
+                                  origin=r_origin, sfe=r_sfe)))
 
 
 if __name__ == "__main__":

@@ -62,12 +62,16 @@ def test_bench_result_schema_includes_stage_ms():
               "live_latency_under_load_s": 0.9,
               "origin_hits": 90000, "origin_bytes": 1 << 30,
               "duration_s": 10.0}
+    sfe = {"fps": 5.6, "latency_ms_p50": 178.0, "latency_ms_p99": 201.0,
+           "bands": 8, "halo_rows": 32, "bytes": 3_000_000,
+           "stage_ms": {}}
     result = bench.build_result(r, r4k, platform="cpu", qp=27, gop=8,
                                 n_1080=64, cold=cold, ladder=ladder,
-                                live=live, origin=origin)
+                                live=live, origin=origin, sfe=sfe)
     assert result["value"] == 33.3
-    assert result["fps_2160p"] == 2.8
     assert set(STAGE_NAMES) <= set(result["stage_ms"])
+    # sfe is a first-class stage key
+    assert "sfe" in result["stage_ms"]
     # dense_retry is a first-class stage (not folded into fetch)
     assert "dense_retry" in result["stage_ms"]
     # the device→host boundary is a pinned, regression-checked metric:
@@ -97,6 +101,16 @@ def test_bench_result_schema_includes_stage_ms():
     assert result["live_dvr_segments"] == 2
     assert result["live_segment_s"] == 1.0
     assert result["live_ingest_fps"] == 12.5
+    # split-frame encoding: per-frame glass-to-bitstream latency is a
+    # MEASURED bench key, and the headline 4K fps takes the better
+    # single-stream path (here SFE's 5.6 beats the GOP wave's 2.8)
+    assert result["sfe_latency_ms_2160p"] == 178.0
+    assert result["sfe_latency_p99_ms_2160p"] == 201.0
+    assert result["sfe_fps_2160p"] == 5.6
+    assert result["sfe_bands"] == 8
+    assert result["sfe_halo_rows"] == 32
+    assert result["fps_2160p"] == 5.6
+    assert result["fps_2160p_path"] == "sfe"
     # origin-at-scale: sustained concurrent HLS sessions + MEASURED
     # segment-latency percentiles + live latency under viewer load
     assert result["origin_sessions_sustained"] == 498
@@ -104,6 +118,19 @@ def test_bench_result_schema_includes_stage_ms():
     assert result["origin_p50_segment_ms"] == 2.1
     assert result["origin_requests"] == 120000
     assert result["live_latency_under_load_s"] == 0.9
+
+
+def test_run_sfe_reports_per_frame_latency():
+    """The SFE bench drives the production split-frame path (per-frame
+    band dispatch/collect) and reports measured per-frame latency
+    percentiles + the band layout it actually ran with."""
+    r = bench._run_sfe(64, 96, nframes=6, qp=27, gop_frames=3, bands=2,
+                       runs=1)
+    assert r["fps"] > 0 and r["bytes"] > 0
+    assert r["bands"] == 2
+    assert r["latency_ms_p99"] >= r["latency_ms_p50"] > 0
+    assert r["stage_ms"]["sfe_frames"] == 6
+    assert r["stage_ms"]["sfe"] > 0
 
 
 def test_run_live_reports_glass_to_playlist_latency():

@@ -108,6 +108,10 @@ class Manifest:
                 "thread",
             "thinvids_tpu.parallel.dispatch:"
             "GopShardEncoder.stage_luma_waves": "thread",
+            # the SFE encoder's per-GOP staging generator runs on the
+            # same tvt-stage thread via background_stage
+            "thinvids_tpu.parallel.dispatch:SfeShardEncoder.stage_waves":
+                "thread",
         })
     #: classes instantiated per request/connection — their `self` is
     #: never shared across threads, so attribute writes are local

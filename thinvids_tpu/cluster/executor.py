@@ -41,18 +41,11 @@ def _live_batch_plan(num_frames: int, gop_frames: int,
     (short tail at end of stream), indices local to the batch. The
     default planner's wave balancing would split GOPs differently per
     batch size / mesh width, making live part boundaries
-    nondeterministic."""
-    from ..core.types import GopSpec, SegmentPlan
+    nondeterministic. (Shared with the SFE encoder's GOP walk —
+    parallel/planner.plan_fixed_segments.)"""
+    from ..parallel.planner import plan_fixed_segments
 
-    gops = []
-    start = 0
-    while start < num_frames:
-        n = min(gop_frames, num_frames - start)
-        gops.append(GopSpec(index=len(gops), start_frame=start,
-                            num_frames=n))
-        start += n
-    return SegmentPlan(gops=tuple(gops), num_devices=num_devices,
-                       frames_per_gop=gop_frames)
+    return plan_fixed_segments(num_frames, gop_frames, num_devices)
 
 
 class _WaveExhausted(RuntimeError):
@@ -103,6 +96,22 @@ class LocalExecutor:
 
     @staticmethod
     def _default_encoder(meta, settings, mesh):
+        """GOP-wave encoder by default; `sfe_bands > 0` selects the
+        split-frame mode (one frame sharded across the mesh as MB-row
+        band slices — the single-stream latency path; 0 keeps current
+        behavior byte-identical). SFE runs on the LOCAL mesh only: the
+        remote backend farms GOP shards across hosts, and a per-frame
+        halo exchange belongs on a mesh interconnect, not HTTP."""
+        sfe_bands = int(settings.get("sfe_bands", 0) or 0)
+        if sfe_bands > 0:
+            from ..parallel.dispatch import SfeShardEncoder
+
+            return SfeShardEncoder(
+                meta, qp=int(settings.qp), mesh=mesh,
+                gop_frames=int(settings.gop_frames),
+                max_segments=int(settings.max_segments),
+                bands=sfe_bands,
+                halo_rows=int(settings.get("sfe_halo_rows", 32)))
         from ..parallel.dispatch import GopShardEncoder
 
         return GopShardEncoder(
@@ -693,8 +702,13 @@ class LocalExecutor:
                     co.activity.emit(
                         "encode", f"wave {i} attempt {n} failed, "
                         f"retrying: {exc}", job_id=job.id, host=self.host)
+                    # staged[0] is the wave's GOP list (GopShardEncoder)
+                    # or a single GopSpec (SfeShardEncoder: one GOP per
+                    # wave, frames sharded as bands within it)
+                    wave_gops = (len(staged[0])
+                                 if hasattr(staged[0], "__len__") else 1)
                     retried = co.store.get(job.id).parts_retried \
-                        + len(staged[0])
+                        + wave_gops
                     co.update_progress(job.id, token, parts_retried=retried)
                     halt_check()
                     pending.appendleft((i, staged,

@@ -177,8 +177,17 @@ def mb_cbp(levels: FrameLevels, mi: int) -> tuple[int, int]:
 
 def pack_slice(levels: FrameLevels, mbw: int, mbh: int, sps: SPS, pps: PPS,
                qp: int, frame_num: int = 0, idr: bool = True,
-               idr_pic_id: int = 0, native: bool | None = None) -> bytes:
-    """Entropy-pack one I-slice picture into an Annex-B NAL unit.
+               idr_pic_id: int = 0, native: bool | None = None,
+               first_mb: int = 0) -> bytes:
+    """Entropy-pack one I slice into an Annex-B NAL unit.
+
+    `levels`/`mbw`/`mbh` describe the SLICE's macroblocks; with a
+    nonzero `first_mb` (split-frame encoding: one horizontal MB-row
+    band per slice) the slice covers MB raster addresses
+    [first_mb, first_mb + mbw*mbh) of a larger picture, and the CAVLC
+    nC / intra-prediction neighbor logic below — which treats the
+    band's first row as having no MBs above — is exactly the §7.4.3
+    cross-slice unavailability a decoder applies.
 
     `native=None` auto-selects the C++ packer when buildable; False forces
     the pure-Python reference path (both produce identical bits — tested).
@@ -186,7 +195,7 @@ def pack_slice(levels: FrameLevels, mbw: int, mbh: int, sps: SPS, pps: PPS,
     bw = BitWriter()
     header = SliceHeader(
         slice_type=SLICE_TYPE_I, frame_num=frame_num, idr=idr, qp=qp,
-        idr_pic_id=idr_pic_id,
+        idr_pic_id=idr_pic_id, first_mb=first_mb,
     )
     header.write(bw, sps, pps)
 

@@ -132,8 +132,9 @@ def pack_p_slice_plane(mv: np.ndarray, luma_plane: np.ndarray,
                        u_dc: np.ndarray, v_dc: np.ndarray,
                        u_ac: np.ndarray, v_ac: np.ndarray,
                        mbw: int, mbh: int, sps: SPS, pps: PPS, qp: int,
-                       frame_num: int, native: bool | None = None) -> bytes:
-    """Entropy-pack one P picture straight from plane-layout levels.
+                       frame_num: int, native: bool | None = None,
+                       first_mb: int = 0) -> bytes:
+    """Entropy-pack one P slice straight from plane-layout levels.
 
     mv: (nmb, 2) int; luma_plane: (16*mbh, 16*mbw) int16 quantized
     coeffs in natural block positions; u_dc/v_dc: (nmb, 4) hadamard-
@@ -141,10 +142,16 @@ def pack_p_slice_plane(mv: np.ndarray, luma_plane: np.ndarray,
     zero. This is the sharded path's pack entry — the device ships raw
     planes (jaxinter.encode_gop_planes) and no relayout pass exists on
     either side when the native packer is available.
+
+    With a nonzero `first_mb` the arrays describe one MB-row BAND of a
+    larger picture coded as its own slice (split-frame encoding); the
+    MV-prediction / skip / nC neighbor logic treating the band's first
+    row as top-of-frame is exactly the decoder's cross-slice
+    unavailability rule.
     """
     bw = BitWriter()
     header = SliceHeader(slice_type=SLICE_TYPE_P, frame_num=frame_num,
-                         idr=False, qp=qp)
+                         idr=False, qp=qp, first_mb=first_mb)
     header.write(bw, sps, pps)
 
     if native is not False:
@@ -164,25 +171,27 @@ def pack_p_slice_plane(mv: np.ndarray, luma_plane: np.ndarray,
     l16, cac = blocked_from_planes(luma_plane, u_ac, v_ac, mbw, mbh)
     cdc = np.stack([u_dc, v_dc], axis=1).astype(np.int32)
     return pack_p_slice(np.asarray(mv, np.int32), l16, cdc, cac, mbw, mbh,
-                        sps, pps, qp, frame_num, native=False)
+                        sps, pps, qp, frame_num, native=False,
+                        first_mb=first_mb)
 
 
 def pack_p_slice(mv: np.ndarray, luma16: np.ndarray, chroma_dc: np.ndarray,
                  chroma_ac: np.ndarray, mbw: int, mbh: int, sps: SPS,
                  pps: PPS, qp: int, frame_num: int,
-                 native: bool | None = None) -> bytes:
-    """Entropy-pack one P picture into an Annex-B NAL unit.
+                 native: bool | None = None, first_mb: int = 0) -> bytes:
+    """Entropy-pack one P slice into an Annex-B NAL unit.
 
     mv: (nmb, 2) half-pel (dy, dx); luma16: (nmb, 16, 16) z-scan
     blocks of 16 zig-zag coeffs; chroma_dc: (nmb, 2, 4);
-    chroma_ac: (nmb, 2, 4, 15).
+    chroma_ac: (nmb, 2, 4, 15). `first_mb` as in
+    :func:`pack_p_slice_plane`.
 
     `native=None` auto-selects the C++ packer when buildable; False
     forces the pure-Python reference path (identical bits — tested).
     """
     bw = BitWriter()
     header = SliceHeader(slice_type=SLICE_TYPE_P, frame_num=frame_num,
-                         idr=False, qp=qp)
+                         idr=False, qp=qp, first_mb=first_mb)
     header.write(bw, sps, pps)
 
     if native is not False:

@@ -173,7 +173,6 @@ def _encode_p_plane(cy, cu, cv, ry, ru, rv, pred_mv, qp, qpc, *, mbw: int,
     zigzag gathers cost ~0.5 s per 1080p GOP on a v5e chip, twice the
     rest of the GOP's compute).
     """
-    H, W = cy.shape
     n = mbw * mbh
     cy16 = cy.astype(jnp.int16)
     cu16 = cu.astype(jnp.int16)
@@ -182,6 +181,22 @@ def _encode_p_plane(cy, cu, cv, ry, ru, rv, pred_mv, qp, qpc, *, mbw: int,
     mv, pred_y, pred_u, pred_v, med_mv = jaxme.me_search(
         cy16, ry, ru, rv, pred_mv, qp.astype(jnp.int32))
 
+    (luma_levels, chroma_dc, chroma_ac, recon_y, recon_u, recon_v) = \
+        _residual_p(cy16, cu16, cv16, pred_y, pred_u, pred_v, qp, qpc,
+                    mbw=mbw, mbh=mbh, blocked=blocked)
+    return (mv.reshape(n, 2), luma_levels, chroma_dc, chroma_ac,
+            recon_y, recon_u, recon_v, med_mv)
+
+
+def _residual_p(cy16, cu16, cv16, pred_y, pred_u, pred_v, qp, qpc, *,
+                mbw: int, mbh: int, blocked: bool = True):
+    """Residual transform/quant/recon for one P frame given its
+    prediction planes — the motion-search-free half of
+    :func:`_encode_p_plane`, split out so the banded (SFE) path can
+    pair it with `jaxme.me_search_banded`. Per-MB local math only: no
+    cross-MB (or cross-band) dependencies."""
+    H, W = cy16.shape
+    n = mbw * mbh
     qp32 = qp.astype(jnp.int32)
     mf_y = _tile_plane(_MF[qp32 % 6], H, W)
     v_y = _tile_plane(_V[qp32 % 6], H, W)
@@ -256,8 +271,7 @@ def _encode_p_plane(cy, cu, cv, ry, ru, rv, pred_mv, qp, qpc, *, mbw: int,
         chroma_dc = jnp.stack([udc, vdc]).astype(jnp.int16)  # (2, n, 4)
         chroma_ac = jnp.stack([uac, vac])                # (2, H/2, W/2)
 
-    return (mv.reshape(n, 2), luma_levels, chroma_dc, chroma_ac,
-            recon_y, recon_u, recon_v, med_mv)
+    return (luma_levels, chroma_dc, chroma_ac, recon_y, recon_u, recon_v)
 
 
 @functools.partial(jax.jit, static_argnames=("mbw", "mbh", "emit_recon"))
@@ -365,3 +379,112 @@ def encode_gop_planes(ys, us, vs, qp, *, mbw: int, mbh: int):
         cacs[:, 0].reshape(-1), cacs[:, 1].reshape(-1),
     ])
     return mv8, flat
+
+
+# ---------------------------------------------------------------------------
+# split-frame encoding (SFE): per-band, per-FRAME step cores
+#
+# The GOP paths above amortize dispatch by batching a whole GOP per
+# program; the SFE path instead steps ONE frame at a time so the
+# per-frame glass-to-bitstream latency is a single device step + band
+# fetch + band-slice pack (parallel/dispatch.SfeShardEncoder). Each
+# core runs on one band's (Hb, W) shard under shard_map; the recon
+# carry chains between steps ON DEVICE.
+# ---------------------------------------------------------------------------
+
+
+def _fixup_band_recon(plane, real_rows, scale: int = 1):
+    """Maintain the SFE recon invariant on a band plane: rows at/past
+    this band's real content (the last band's MB padding) are the
+    edge-replication of the last REAL row. The full-frame search pads
+    its reference with edge replication below the frame; without this
+    fixup the padding rows would instead hold the recon of replicated
+    SOURCE rows — close, but not the bits the full-frame program (or a
+    conformant decoder's edge clamp) sees."""
+    H = plane.shape[0]
+    real = jnp.maximum(real_rows // scale, 1)
+    rows = jnp.arange(H)
+    return jnp.take(plane, jnp.minimum(rows, real - 1), axis=0)
+
+
+def sfe_intra_band(y, u, v, qp, real_rows, *, mbw: int, mbh_band: int):
+    """One band's IDR step: slice-local intra prediction — the band's
+    first MB row predicts like a frame's row 0 because the MBs above
+    live in ANOTHER slice and are unavailable to intra prediction
+    (§8.3: exactly what a conformant decoder reconstructs), so no
+    cross-band exchange is needed on intra frames.
+
+    Returns (dense, rest, (ry, ru, rv, pred_mv)): dense is the
+    hadamard-DC prefix [il_dc | ic_dc] shipped uncompressed (the only
+    levels that exceed int8 at practical QPs — same rationale as
+    dispatch._per_gop_sparse), rest is [il_ac | ic_ac] for the sparse
+    transfer, and the carry holds the fixed-up recon + a zero median
+    MV (each GOP's temporal predictor restarts at its IDR)."""
+    qp = qp.astype(jnp.int32)
+    (il_dc, il_ac, ic_dc, ic_ac, ry, ru, rv) = _intra_core(
+        y, u, v, qp, mbw=mbw, mbh=mbh_band)
+    ry = _fixup_band_recon(ry.astype(jnp.int16), real_rows)
+    ru = _fixup_band_recon(ru.astype(jnp.int16), real_rows, 2)
+    rv = _fixup_band_recon(rv.astype(jnp.int16), real_rows, 2)
+    dense = jnp.concatenate([il_dc.reshape(-1).astype(jnp.int16),
+                             ic_dc.reshape(-1).astype(jnp.int16)])
+    rest = jnp.concatenate([il_ac.reshape(-1).astype(jnp.int16),
+                            ic_ac.reshape(-1).astype(jnp.int16)])
+    zero_mv = jnp.zeros(2, jnp.int32) + _varying_zero(ry)
+    return dense, rest, (ry, ru, rv, zero_mv)
+
+
+def sfe_intra_band_dense(y, u, v, qp, real_rows, *, mbw: int,
+                         mbh_band: int):
+    """Dense-transfer variant of :func:`sfe_intra_band`: one flat int16
+    vector in the standard intra layout (layout.unflatten_intra's
+    inverse) — the escape fallback path."""
+    qp = qp.astype(jnp.int32)
+    (il_dc, il_ac, ic_dc, ic_ac, ry, ru, rv) = _intra_core(
+        y, u, v, qp, mbw=mbw, mbh=mbh_band)
+    ry = _fixup_band_recon(ry.astype(jnp.int16), real_rows)
+    ru = _fixup_band_recon(ru.astype(jnp.int16), real_rows, 2)
+    rv = _fixup_band_recon(rv.astype(jnp.int16), real_rows, 2)
+    flat = jnp.concatenate([
+        il_dc.reshape(-1).astype(jnp.int16),
+        il_ac.reshape(-1).astype(jnp.int16),
+        ic_dc.reshape(-1).astype(jnp.int16),
+        ic_ac.reshape(-1).astype(jnp.int16)])
+    zero_mv = jnp.zeros(2, jnp.int32) + _varying_zero(ry)
+    return flat, (ry, ru, rv, zero_mv)
+
+
+def sfe_p_band(y, u, v, carry, qp, real_rows, *, mbw: int, mbh_band: int,
+               halo_rows: int, num_bands: int, axis_name):
+    """One band's P step: banded motion search (halo exchange + psum'd
+    global centers/median, jaxme.me_search_banded) + the shared
+    residual core, emitting PLANE-layout levels for the per-frame
+    sparse transfer.
+
+    Returns (mv8 (nmb, 2) int8, flat int16 [luma plane | u dc | v dc |
+    u ac | v ac] — a single-frame slice of encode_gop_planes' P layout,
+    so layout.unflatten_p_planes(flat, mv8, 2, ...) is the host
+    inverse), plus the chained (ry, ru, rv, med_mv) carry."""
+    if 2 * SEARCH_RANGE > 127:
+        raise ValueError("SEARCH_RANGE exceeds the int8 MV transfer")
+    ry, ru, rv, pred_mv = carry
+    qp32 = qp.astype(jnp.int32)
+    qpc = _QPC[jnp.clip(qp32, 0, 51)]
+    cy16 = y.astype(jnp.int16)
+    cu16 = u.astype(jnp.int16)
+    cv16 = v.astype(jnp.int16)
+    mv, py, pu, pv, med = jaxme.me_search_banded(
+        cy16, ry, ru, rv, pred_mv, qp32, halo_rows=halo_rows,
+        num_bands=num_bands, axis_name=axis_name, real_rows=real_rows)
+    (lp, cdc, cac, ry2, ru2, rv2) = _residual_p(
+        cy16, cu16, cv16, py, pu, pv, qp32, qpc, mbw=mbw, mbh=mbh_band,
+        blocked=False)
+    ry2 = _fixup_band_recon(ry2, real_rows)
+    ru2 = _fixup_band_recon(ru2, real_rows, 2)
+    rv2 = _fixup_band_recon(rv2, real_rows, 2)
+    flat = jnp.concatenate([
+        lp.reshape(-1),
+        cdc[0].reshape(-1), cdc[1].reshape(-1),
+        cac[0].reshape(-1), cac[1].reshape(-1)])
+    mv8 = mv.reshape(-1, 2).astype(jnp.int8)
+    return mv8, flat, (ry2, ru2, rv2, med)

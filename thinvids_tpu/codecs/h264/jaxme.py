@@ -734,6 +734,184 @@ def me_search_pallas(cur_y16, ref_y16, ref_u16, ref_v16, centers, lam,
             pv[:H // 2, :W // 2].astype(jnp.int16))
 
 
+# ---------------------------------------------------------------------------
+# split-frame encoding (SFE): banded ME with ICI halo exchange
+#
+# One frame is sharded as horizontal MB-row bands, one device per band
+# (parallel/dispatch.SfeShardEncoder). The search itself is the SAME
+# kernel/XLA program as the full-frame path, run on a band extended by
+# `halo` reference rows from each neighbor band (lax.ppermute over the
+# mesh interconnect); the global-motion probe and the carried-median
+# center are computed with cross-band psums, so every band searches
+# exactly the centers the full-frame program would. With a halo that
+# covers the full candidate reach (SEARCH_RANGE + window + 6-tap
+# interpolation = halo_clamp's bound) the per-MB (mv, pred) results
+# are bit-identical to full-frame `me_search`; a smaller halo clamps
+# the VERTICAL center magnitude so no candidate ever reads past the
+# halo — a documented bound, not silent drift.
+# ---------------------------------------------------------------------------
+
+def halo_clamp(halo_rows: int) -> int:
+    """Largest even vertical center magnitude (pel) whose candidate
+    window (± _WR pel) plus 6-tap interpolation reach (3 rows) stays
+    inside a `halo_rows`-row halo. >= _CLIM means the banded search is
+    unclamped (bit-identical to full-frame)."""
+    return max(0, min(_CLIM, ((halo_rows - _WR - 3) // 2) * 2))
+
+
+def band_halo_exchange(plane, halo: int, axis_name, num_bands: int):
+    """(Hb, W) band plane → (Hb + 2*halo, W) extended with `halo` REAL
+    rows from each neighbor band via `lax.ppermute`; the mesh-edge
+    bands (no neighbor) edge-replicate their own boundary row, exactly
+    matching the full-frame search's edge padding. `axis_name=None` (or
+    one band) degrades to pure edge replication — the single-device
+    form of the same program."""
+    H, W = plane.shape
+    if halo > H and axis_name is not None and num_bands > 1:
+        # one ppermute hop reaches ONE neighbor: a halo deeper than the
+        # band itself would need rows from two bands away. Callers clamp
+        # (SfeShardEncoder caps halo_rows at the band height, shrinking
+        # the vertical search bound instead of failing).
+        raise ValueError(f"halo {halo} exceeds band height {H}")
+    top_edge = jnp.broadcast_to(plane[:1], (halo, W))
+    bot_edge = jnp.broadcast_to(plane[H - 1:], (halo, W))
+    if axis_name is None or num_bands <= 1:
+        return jnp.concatenate([top_edge, plane, bot_edge])
+    down = [(i, i + 1) for i in range(num_bands - 1)]
+    up = [(i + 1, i) for i in range(num_bands - 1)]
+    # band b's top halo = band b-1's bottom rows; bottom halo = band
+    # b+1's top rows. ppermute leaves non-receiving bands zero-filled;
+    # those are exactly the mesh-edge bands replaced below.
+    recv_top = jax.lax.ppermute(plane[H - halo:], axis_name, down)
+    recv_bot = jax.lax.ppermute(plane[:halo], axis_name, up)
+    idx = jax.lax.axis_index(axis_name)
+    top = jnp.where(idx == 0, top_edge, recv_top)
+    bot = jnp.where(idx == num_bands - 1, bot_edge, recv_bot)
+    return jnp.concatenate([top, plane, bot])
+
+
+def banded_coarse_probe(cur16, ref16, real_rows, axis_name,
+                        num_bands: int, sr: int = SEARCH_RANGE):
+    """`coarse_probe` decomposed across bands: each band contributes
+    the partial SAD of its REAL rows for every candidate window (halo
+    cells arrive from the neighbors at quarter-res granularity, so the
+    window slices see exactly the full-frame probe's padded plane) and
+    the per-window costs psum — the argmin is the SAME global-motion
+    center on every band. `real_rows` masks the last band's padding
+    rows out of the cost, keeping the sums equal to the full-frame
+    probe's."""
+    qs = _COARSE
+    qsr = sr // qs
+    cq = _box_sum(cur16, qs)
+    rq = _box_sum(ref16, qs)
+    hc, wc = cq.shape
+    rows = jnp.arange(hc)
+    real_c = jnp.maximum(real_rows // qs, 1)
+    # cells at/past the band's real content hold padding: clamp them to
+    # the last real cell row so (a) this band's cost rows are masked
+    # anyway and (b) the halo cells it SENDS (and its own bottom edge
+    # replication) equal the full-frame probe's bottom edge padding.
+    rq = jnp.take(rq, jnp.minimum(rows, real_c - 1), axis=0)
+    rq_ext = band_halo_exchange(rq, qsr, axis_name, num_bands)
+    rq_ext = jnp.pad(rq_ext, ((0, 0), (qsr, qsr)), mode="edge")
+    mask = (rows < real_c)[:, None]
+    n = 2 * qsr + 1
+    wins = jnp.stack([jax.lax.slice(rq_ext, (oy, ox), (oy + hc, ox + wc))
+                      for oy in range(n) for ox in range(n)])
+    cost = (jnp.abs(cq[None] - wins) * mask[None]).sum((1, 2))
+    if axis_name is not None and num_bands > 1:
+        cost = jax.lax.psum(cost, axis_name)
+    bi = jnp.argmin(cost).astype(jnp.int32)
+    return jnp.stack([bi // n - qsr, bi % n - qsr]) * qs
+
+
+def banded_centers_from(cur16, ref16, pred_mv_h, real_rows,
+                        halo_rows: int, axis_name, num_bands: int):
+    """(3, 2) even-pel centers for one band's search: psum'd probe,
+    carried global median, zero — the banded mirror of `centers_from`,
+    with the vertical component additionally clamped to
+    `halo_clamp(halo_rows)` so every candidate read stays inside the
+    exchanged halo."""
+    probe = banded_coarse_probe(cur16, ref16, real_rows, axis_name,
+                                num_bands)
+    med_pel = jnp.clip((pred_mv_h + 2) >> 2, -(_CLIM // 2),
+                       _CLIM // 2) * 2
+    lims = jnp.asarray([min(halo_clamp(halo_rows), _CLIM), _CLIM],
+                       jnp.int32)
+    probe = jnp.clip(probe, -lims, lims)
+    med_pel = jnp.clip(med_pel, -lims, lims)
+    zero = jnp.zeros(2, jnp.int32) + (cur16.reshape(-1)[0] * 0).astype(
+        jnp.int32)
+    return jnp.stack([probe, med_pel, zero])
+
+
+def hist_median_banded(mv_flat, mb_mask, lim: int, axis_name,
+                       num_bands: int):
+    """`hist_median` decomposed across bands: per-band histogram counts
+    over the REAL macroblocks psum before the cumsum/argmax, so every
+    band carries the same global median (the next frame's temporal
+    search center)."""
+    bins = jnp.arange(-lim, lim + 1)
+    cnt = ((mv_flat[:, None, :] == bins[None, :, None])
+           & mb_mask[:, None, None]).sum(0)
+    n = mb_mask.sum()
+    if axis_name is not None and num_bands > 1:
+        cnt = jax.lax.psum(cnt, axis_name)
+        n = jax.lax.psum(n, axis_name)
+    cum = jnp.cumsum(cnt, axis=0)
+    return ((cum >= (n + 1) // 2).argmax(axis=0) - lim).astype(jnp.int32)
+
+
+def me_search_banded(cur_y16, ref_y16, ref_u16, ref_v16, pred_mv_h, qp,
+                     *, halo_rows: int, num_bands: int, axis_name,
+                     real_rows):
+    """Full ME+MC for one P frame of ONE BAND (the SFE search).
+
+    cur/ref planes are this band's (Hb, W) shard (Hb a multiple of 16);
+    `halo_rows` (a multiple of 16) reference rows per side arrive from
+    the neighbor bands via :func:`band_halo_exchange`; `real_rows` is
+    the traced count of real pixel rows (the last band may carry
+    padding rows — masked out of the probe and median, and their MBs
+    are never entropy-coded by the host). The search runs the
+    UNCHANGED kernel/XLA program on the extended planes and slices the
+    band's MB rows back out; per-MB selection is independent, so the
+    extended rows' results are simply discarded.
+
+    Returns (mv (Hb/16, mbw, 2) int32 half-pel, pred_y, pred_u, pred_v
+    int16 band planes, med_mv_h (2,) int32 — the GLOBAL median)."""
+    Hb, W = cur_y16.shape
+    if halo_rows <= 0 or halo_rows % 16:
+        raise ValueError("halo_rows must be a positive multiple of 16")
+    halo = halo_rows
+    ry_ext = band_halo_exchange(ref_y16, halo, axis_name, num_bands)
+    ru_ext = band_halo_exchange(ref_u16, halo // 2, axis_name, num_bands)
+    rv_ext = band_halo_exchange(ref_v16, halo // 2, axis_name, num_bands)
+    # halo rows of CUR only feed the discarded extension MBs' SADs;
+    # edge replication keeps them in range
+    cur_ext = jnp.concatenate([
+        jnp.broadcast_to(cur_y16[:1], (halo, W)), cur_y16,
+        jnp.broadcast_to(cur_y16[Hb - 1:], (halo, W))])
+    centers = banded_centers_from(cur_y16, ref_y16, pred_mv_h, real_rows,
+                                  halo, axis_name, num_bands)
+    lam = jnp.asarray(LAMBDA_H)[jnp.clip(qp, 0, 51)]
+    if use_pallas():
+        mv_e, py_e, pu_e, pv_e = me_search_pallas(
+            cur_ext, ry_ext, ru_ext, rv_ext, centers, lam)
+    else:
+        mv_e, py_e, pu_e, pv_e = me_search_xla(
+            cur_ext, ry_ext, ru_ext, rv_ext, centers, lam)
+    hm = halo // 16
+    mbh_b = Hb // 16
+    mv = jax.lax.slice_in_dim(mv_e, hm, hm + mbh_b, axis=0)
+    py = jax.lax.slice_in_dim(py_e, halo, halo + Hb, axis=0)
+    pu = jax.lax.slice_in_dim(pu_e, halo // 2, (halo + Hb) // 2, axis=0)
+    pv = jax.lax.slice_in_dim(pv_e, halo // 2, (halo + Hb) // 2, axis=0)
+    mb_mask = jnp.repeat(jnp.arange(mbh_b) * 16 < real_rows, mv.shape[1])
+    med = hist_median_banded(mv.reshape(-1, 2), mb_mask,
+                             2 * SEARCH_RANGE, axis_name, num_bands)
+    return mv, py, pu, pv, med
+
+
 def me_search(cur_y16, ref_y16, ref_u16, ref_v16, pred_mv_h, qp):
     """Full ME+MC for one P frame. Inputs int16 planes (H, W multiples
     of 16); pred_mv_h (2,) int32 half-pel (previous frame's median);

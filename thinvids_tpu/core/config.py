@@ -116,6 +116,19 @@ DEFAULT_SETTINGS: dict[str, Any] = {
     # coordinator's GIL — the 4K host-pack ceiling.
     "compact_transfer": True,
     "pack_backend": "thread",        # thread | process
+    # split-frame encoding (parallel/dispatch.SfeShardEncoder): shard
+    # ONE frame across the mesh as horizontal MB-row bands, each coded
+    # as its own H.264 slice — the single-stream latency mode.
+    # sfe_bands (TVT_SFE_BANDS): bands per frame; 0 keeps the default
+    # GOP-wave encoder (current behavior, byte-identical); > 0 caps at
+    # the local device count (and at the frame's MB rows).
+    # sfe_halo_rows (TVT_SFE_HALO_ROWS): reference rows exchanged with
+    # each neighbor band for motion search (multiple of 16; capped at
+    # the band height). >= 32 covers the full ±16-pel search + 6-tap
+    # interpolation reach (banded ME bit-identical to full-frame); 16
+    # clamps the vertical search to ±8 pel centers (documented bound).
+    "sfe_bands": 0,
+    "sfe_halo_rows": 32,
     # streaming ingest (ingest/decode.py + parallel/dispatch.py):
     # staged waves the background staging thread decodes + uploads
     # ahead of dispatch (TVT_DECODE_AHEAD). Each staged-ahead wave is
@@ -241,6 +254,9 @@ _CLAMPS: dict[str, Callable[[Any], Any]] = {
     "pack_backend": lambda v: str(v)
     if str(v) in ("thread", "process")
     else "thread",
+    "sfe_bands": lambda v: min(64, max(0, as_int(v, 0))),
+    # multiple of 16 (band/ext-plane MB alignment), floor 16, cap 128
+    "sfe_halo_rows": lambda v: min(128, max(16, (as_int(v, 32) // 16) * 16)),
     # capped well below pipeline_window's 64: every staged-ahead wave
     # pins HBM-resident input arrays (see DEFAULT_SETTINGS note)
     "decode_ahead": lambda v: min(16, max(1, as_int(v, 2))),
@@ -377,7 +393,7 @@ JOB_SETTING_KEYS = frozenset(
     {"gop_frames", "qp", "rc_mode", "target_bitrate_kbps",
      "max_segments", "profile_dir", "ladder_rungs", "segment_s",
      "live_stall_s", "dvr_window_s", "job_priority",
-     "live_part_budget_s"}
+     "live_part_budget_s", "sfe_bands", "sfe_halo_rows"}
 )
 
 

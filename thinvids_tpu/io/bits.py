@@ -187,6 +187,17 @@ def ebsp_to_rbsp(ebsp: bytes) -> bytes:
     return bytes(out)
 
 
+def slice_first_mb(nal: bytes) -> int:
+    """first_mb_in_slice of a raw VCL NAL (header byte + EBSP payload)
+    — the slice header's leading ue(v). Only a short prefix is
+    unescaped: enough bits for any legal MB address. Used to group a
+    picture's slices into ONE access unit (a multi-slice picture's
+    later slices have first_mb != 0 and must ride with the slice that
+    opened the picture — split-frame encoding emits one slice per
+    MB-row band)."""
+    return BitReader(ebsp_to_rbsp(nal[1:12])).ue()
+
+
 def annexb_nal(nal_ref_idc: int, nal_unit_type: int, rbsp: bytes,
                long_start_code: bool = True) -> bytes:
     """Wrap an RBSP payload as one Annex-B NAL unit.

@@ -54,11 +54,29 @@ def split_annexb(stream: bytes) -> list[bytes]:
 def annexb_to_samples(stream: bytes
                       ) -> tuple[bytes, bytes, list[bytes], list[bool]]:
     """(sps, pps, samples, keyflags): AVCC length-prefixed samples, one
-    per coded picture (this encoder emits one slice per picture)."""
+    per coded PICTURE. A picture may span several slices (split-frame
+    encoding codes one slice per MB-row band): a VCL NAL with
+    first_mb_in_slice == 0 opens a new sample and the picture's later
+    slices (first_mb != 0) ride in the same sample — one NAL per sample
+    would split a frame across MP4 samples and desync every timestamp
+    after it."""
+    from .bits import slice_first_mb
+
     sps = b""
     pps = b""
     samples: list[bytes] = []
     keyflags: list[bool] = []
+    cur: list[bytes] = []
+    cur_key = False
+
+    def flush() -> None:
+        nonlocal cur, cur_key
+        if cur:
+            samples.append(b"".join(
+                struct.pack(">I", len(n)) + n for n in cur))
+            keyflags.append(cur_key)
+            cur, cur_key = [], False
+
     for nal in split_annexb(stream):
         ntype = nal[0] & 0x1F
         if ntype == _NAL_SPS:
@@ -68,8 +86,11 @@ def annexb_to_samples(stream: bytes
         elif ntype in (_NAL_SEI, _NAL_AUD):
             continue
         elif ntype in (1, _NAL_IDR):
-            samples.append(struct.pack(">I", len(nal)) + nal)
-            keyflags.append(ntype == _NAL_IDR)
+            if slice_first_mb(nal) == 0:
+                flush()
+            cur.append(nal)
+            cur_key = cur_key or ntype == _NAL_IDR
+    flush()
     if not sps or not pps:
         raise ValueError("stream has no SPS/PPS")
     return sps, pps, samples, keyflags

@@ -74,11 +74,16 @@ def _load():
 
 
 def split_access_units(stream: bytes) -> list[bytes]:
-    """Split an Annex-B stream into access units (one VCL NAL each).
+    """Split an Annex-B stream into access units (one coded PICTURE
+    each; a picture may span several slices — split-frame encoding
+    emits one slice per MB-row band, and a VCL NAL with
+    first_mb_in_slice == 0 is what OPENS a new access unit, §7.4.1.2.4).
 
     Parameter-set NALs travel with the following slice NAL.
     """
     import re
+
+    from ..io.bits import slice_first_mb
 
     # start-code positions (3-byte form; 4-byte includes a leading zero)
     starts = [m.start() for m in re.finditer(b"\x00\x00\x01", stream)]
@@ -91,19 +96,27 @@ def split_access_units(stream: bytes) -> list[bytes]:
         if i + 1 < len(starts) and stream[end - 1] == 0:
             end -= 1
         nal_type = stream[s + 3] & 31
-        units.append((nal_type, stream[begin:end]))
+        first_mb = (slice_first_mb(stream[s + 3:end])
+                    if nal_type in (1, 5) else None)
+        units.append((nal_type, first_mb, stream[begin:end]))
     aus: list[bytes] = []
     pending = b""
-    for nal_type, chunk in units:
+    pending_vcl = False
+    for nal_type, first_mb, chunk in units:
+        # a completed AU (it has its VCL NALs) closes when the next
+        # NAL can't extend it: a first_mb==0 VCL NAL opens the next
+        # picture, and a non-VCL NAL (mid-stream SPS/PPS at a GOP
+        # head) belongs WITH the following slice, not the previous AU
+        if pending_vcl and (nal_type not in (1, 5) or first_mb == 0):
+            aus.append(pending)
+            pending, pending_vcl = b"", False
         pending += chunk
-        if nal_type in (1, 5):  # VCL NAL closes the access unit
-            aus.append(pending)
-            pending = b""
+        pending_vcl = pending_vcl or nal_type in (1, 5)
     if pending:
-        if aus:
-            aus[-1] += pending
-        else:
+        if pending_vcl or not aus:
             aus.append(pending)
+        else:
+            aus[-1] += pending              # trailing parameter sets
     return aus
 
 
