@@ -65,9 +65,12 @@ def test_bench_result_schema_includes_stage_ms():
     sfe = {"fps": 5.6, "latency_ms_p50": 178.0, "latency_ms_p99": 201.0,
            "bands": 8, "halo_rows": 32, "bytes": 3_000_000,
            "stage_ms": {}}
+    trace = {"fps_off": 33.5, "fps_on": 33.1, "overhead_pct": 1.2,
+             "sampled": True}
     result = bench.build_result(r, r4k, platform="cpu", qp=27, gop=8,
                                 n_1080=64, cold=cold, ladder=ladder,
-                                live=live, origin=origin, sfe=sfe)
+                                live=live, origin=origin, sfe=sfe,
+                                trace=trace)
     assert result["value"] == 33.3
     assert set(STAGE_NAMES) <= set(result["stage_ms"])
     # sfe is a first-class stage key
@@ -118,6 +121,20 @@ def test_bench_result_schema_includes_stage_ms():
     assert result["origin_p50_segment_ms"] == 2.1
     assert result["origin_requests"] == 120000
     assert result["live_latency_under_load_s"] == 0.9
+    # distributed-tracing cost on the e2e hot path is a pinned BENCH
+    # key (acceptance gate: < 3% on the driver's run)
+    assert result["trace_overhead_pct"] == 1.2
+
+
+def test_run_trace_overhead_measures_both_paths():
+    """The tracing-overhead bench runs the SAME waves traced and
+    untraced, asserts byte parity internally, and reports both fps
+    figures plus the relative cost."""
+    r = bench._run_trace_overhead(64, 48, nframes=4, qp=27,
+                                  gop_frames=2, runs=1)
+    assert r["fps_off"] > 0 and r["fps_on"] > 0
+    assert r["sampled"] is True
+    assert isinstance(r["overhead_pct"], float)
 
 
 def test_run_sfe_reports_per_frame_latency():

@@ -133,6 +133,16 @@ class _LadderStages:
     def bump(self, counter: str, n: int = 1) -> None:
         self._ladder._stager.stages.bump(counter, n)
 
+    def set_tracer(self, recorder) -> None:
+        """Propagate a span recorder (obs/trace) to every rung
+        encoder's profile so the whole rendition set's stages land in
+        ONE job trace."""
+        for enc in self._ladder._all_encoders():
+            enc.stages.set_tracer(recorder)
+
+    def tracer(self):
+        return self._ladder._stager.stages.tracer()
+
     def reset(self) -> None:
         for enc in self._ladder._all_encoders():
             enc.stages.reset()

@@ -54,6 +54,10 @@ class Manifest:
         "thinvids_tpu.origin",          # whole package
         "thinvids_tpu.tools.loadgen",
         "thinvids_tpu.cluster.qos",
+        # the observability layer (metrics registry, trace store,
+        # flight recorder) runs on coordinator/worker control-plane
+        # threads and inside jax-free sidecars
+        "thinvids_tpu.obs",             # whole package
         # self-hosting: the analyzer itself runs inside tier-1 as a
         # fast jax-free subprocess
         "thinvids_tpu.analysis",
@@ -141,6 +145,9 @@ class Manifest:
             "TVT_OUTPUT_DIR": "encode output root (cli.py)",
             "TVT_COORDINATOR_URL": "agent/worker coordinator URL (cli.py)",
             "TVT_LOG_LEVEL": "root log level (core/log.py)",
+            "TVT_LOG_FORMAT": "log line format: json = one structured "
+                              "object per line with trace/job ids "
+                              "(core/log.py)",
             "TVT_NATIVE_SANITIZE": "asan|ubsan native build mode "
                                    "(native/__init__.py)",
         })

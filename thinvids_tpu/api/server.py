@@ -34,14 +34,17 @@ import json
 import os
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 from urllib.parse import parse_qs, urlparse
 
-from ..core.config import update_live_settings
-from ..core.status import Status
+from ..core.config import as_bool, update_live_settings
+from ..core.status import ShardState, Status
 from ..cluster.coordinator import Coordinator
 from ..cluster.jobs import Job
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 
 
 class ApiError(Exception):
@@ -95,6 +98,15 @@ class _FileResponse:
         self.plan = plan
 
 
+class _TextResponse:
+    """Handler payload sentinel: serve a plain-text body (the
+    Prometheus exposition at GET /metrics)."""
+
+    def __init__(self, text: str, content_type: str = "text/plain") -> None:
+        self.body = text.encode("utf-8")
+        self.content_type = content_type
+
+
 class ApiServer:
     """Threaded HTTP server bound to a Coordinator instance.
 
@@ -118,6 +130,10 @@ class ApiServer:
         #: origin serving state (origin/): hot-segment cache, request
         #: counters, per-job session gauges, bounded reload waiters
         self.origin = Origin(coordinator._settings_fn)
+        #: serializes the scrape-time gauge refresh in /metrics: two
+        #: concurrent scrapes racing clear()-then-repopulate would
+        #: render doubled or partial gauge values
+        self._scrape_lock = threading.Lock()
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -171,6 +187,14 @@ class ApiServer:
                 self.end_headers()
                 if self.command != "HEAD":
                     self.wfile.write(content)
+
+            def _reply_text(self, tr: "_TextResponse") -> None:
+                self.send_response(200)
+                self.send_header("Content-Type", tr.content_type)
+                self.send_header("Content-Length", str(len(tr.body)))
+                self.end_headers()
+                if self.command != "HEAD":
+                    self.wfile.write(tr.body)
 
             def _reply_file(self, fr: _FileResponse) -> None:
                 plan = fr.plan
@@ -240,6 +264,11 @@ class ApiServer:
             def _dispatch(self, method: str) -> None:
                 url = urlparse(self.path)
                 query = {k: v[-1] for k, v in parse_qs(url.query).items()}
+                # origin segment serve-time histogram: the whole /hls
+                # request, plan through last body byte (includes any
+                # blocking-reload hold — that IS the player's wait)
+                is_hls = url.path.startswith("/hls/")
+                t0 = time.perf_counter() if is_hls else 0.0
                 try:
                     if method == "GET" and url.path in ("/", "/ui"):
                         from .. import ui
@@ -262,6 +291,9 @@ class ApiServer:
                         except OSError:
                             self._reply(404, {"error": "file unavailable"})
                         return
+                    if isinstance(payload, _TextResponse):
+                        self._reply_text(payload)
+                        return
                     self._reply(status, payload)
                 except ApiError as exc:
                     self._reply(exc.status, {"error": exc.message},
@@ -270,6 +302,10 @@ class ApiServer:
                     self._reply(400, {"error": str(exc)})
                 except Exception as exc:    # noqa: BLE001 - surface, don't die
                     self._reply(500, {"error": f"{type(exc).__name__}: {exc}"})
+                finally:
+                    if is_hls:
+                        obs_metrics.ORIGIN_SERVE_SECONDS.observe(
+                            time.perf_counter() - t0)
 
             def do_GET(self) -> None:
                 self._dispatch("GET")
@@ -337,8 +373,11 @@ class ApiServer:
         ("POST", r"^/nodes/enable/(?P<host>[\w.-]+)$", "node_enable"),
         ("DELETE", r"^/nodes/delete/(?P<host>[\w.-]+)$", "node_delete"),
         ("GET", r"^/metrics_snapshot$", "metrics_snapshot"),
+        ("GET", r"^/metrics$", "metrics"),
+        ("GET", r"^/trace/(?P<job_id>[\w-]+)$", "trace"),
         ("POST", r"^/work/claim$", "work_claim"),
         ("POST", r"^/work/part/(?P<shard_id>[\w:-]+)$", "work_part"),
+        ("POST", r"^/work/spans$", "work_spans"),
         ("POST", r"^/work/status$", "work_status"),
         ("GET", r"^/work/board$", "work_board"),
         ("GET", r"^/settings$", "get_settings"),
@@ -351,8 +390,9 @@ class ApiServer:
     ]
 
     #: handlers that take the request context (conditional/range
-    #: headers, client identity) — the origin-served file routes
-    _CTX_ROUTES = frozenset({"hls", "preview", "result"})
+    #: headers, client identity) — the origin-served file routes plus
+    #: the span upload (X-Tvt-Trace trace-context header)
+    _CTX_ROUTES = frozenset({"hls", "preview", "result", "work_spans"})
 
     def route(self, method: str, path: str, query: dict[str, str],
               body: dict[str, Any],
@@ -832,6 +872,11 @@ class ApiServer:
 
         disp = _sys.modules.get("thinvids_tpu.parallel.dispatch")
         out["stage_ms"] = disp.stage_snapshot() if disp is not None else {}
+        # SFE per-frame latency percentiles — the frame_done_t data
+        # the bench always recorded, finally summarized for operators
+        # (dashboard SFE line + this snapshot)
+        out["sfe_latency_ms"] = (disp.frame_latency_percentiles()
+                                 if disp is not None else {})
         if self.work is not None:
             out["work"] = self.work.snapshot()
         # origin serving counters + per-job concurrent-session gauges
@@ -841,6 +886,54 @@ class ApiServer:
         if qos is not None:
             out["qos"] = qos.snapshot()
         return 200, out
+
+    def _h_metrics(self, query, body) -> tuple[int, Any]:
+        """Prometheus text exposition over the obs/ metrics registry.
+
+        Counters and histograms stream in as subsystems record them;
+        point-in-time state (job statuses, shard-board lease states,
+        per-job viewer sessions) is refreshed at scrape time so the
+        gauges reflect NOW, not the last event. Gated by the
+        `metrics_enabled` setting (TVT_METRICS_ENABLED)."""
+        snap = self.coordinator._settings_fn()
+        if not as_bool(snap.get("metrics_enabled", True), True):
+            raise ApiError(404, "metrics disabled (metrics_enabled)")
+        # refresh + render under one lock: a concurrent scrape racing
+        # the clear()-then-repopulate would see doubled/partial gauges
+        with self._scrape_lock:
+            jobs = obs_metrics.JOBS_BY_STATUS
+            jobs.clear()
+            for status in Status:
+                jobs.labels(status.value).set(0)
+            for job in self.coordinator.store.list():
+                jobs.labels(job.status.value).inc()
+            sessions = obs_metrics.SESSIONS
+            sessions.clear()
+            for job_id, n in self.origin.sessions.concurrent().items():
+                sessions.labels(job_id).set(n)
+            shard_states = obs_metrics.SHARD_STATES
+            shard_states.clear()
+            counts = (self.work.snapshot()["shards"]
+                      if self.work is not None else {})
+            for state in ShardState:
+                shard_states.labels(state.value).set(
+                    counts.get(state.value, 0))
+            return 200, _TextResponse(
+                obs_metrics.REGISTRY.render(),
+                "text/plain; version=0.0.4; charset=utf-8")
+
+    def _h_trace(self, query, body, job_id) -> tuple[int, Any]:
+        """Chrome trace-event JSON export of one job's distributed
+        trace (coordinator spans + any worker-uploaded spans, one
+        trace id) — drag the response into Perfetto. 404 when the job
+        never ran with tracing sampled on."""
+        self._get_job(job_id)
+        doc = obs_trace.TRACE.export_chrome(job_id)
+        if doc is None:
+            raise ApiError(404, f"no trace recorded for job {job_id} "
+                                f"(unsampled, or evicted from the "
+                                f"trace ring)")
+        return 200, doc
 
     # -- worker pull API (cluster/remote.py ShardBoard) ----------------
 
@@ -873,6 +966,27 @@ class ApiServer:
         segments = unpack_parts(bytes(raw))
         ok = board.submit_part(shard_id, host, segments)
         return 200, {"ok": ok}
+
+    def _h_work_spans(self, query, body, ctx=None) -> tuple[int, Any]:
+        """Worker-side span upload (the trace side of the /work
+        protocol): the X-Tvt-Trace header carries the trace id the
+        worker learned from its claim descriptor, and the body holds
+        the shard's collected spans. Spans whose trace id no longer
+        matches the job's CURRENT trace are dropped — a straggler from
+        a superseded run must not pollute the new run's trace."""
+        headers = (ctx or {}).get("headers") or {}
+        trace_id = str(headers.get("X-Tvt-Trace") or "").strip()
+        if not trace_id:
+            raise ApiError(400, "X-Tvt-Trace header required")
+        job_id = str(body.get("job_id", "")).strip()
+        if not job_id:
+            raise ApiError(400, "job_id required")
+        spans = body.get("spans")
+        if not isinstance(spans, list):
+            raise ApiError(400, "spans must be a list")
+        recorded = obs_trace.TRACE.ingest(
+            job_id, trace_id, spans, host=str(body.get("host", "")))
+        return 200, {"recorded": recorded}
 
     def _h_work_status(self, query, body) -> tuple[int, Any]:
         board = self._work_board_or_503()

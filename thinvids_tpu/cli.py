@@ -203,6 +203,45 @@ def run_feed(args: argparse.Namespace) -> None:
              args.dest, args.rate if args.rate > 0 else float("inf"))
 
 
+def run_trace(args: argparse.Namespace) -> None:
+    """Fetch one job's distributed trace (GET /trace/<job>) and write
+    it as a Chrome trace-event JSON file — open it in Perfetto
+    (https://ui.perfetto.dev) or chrome://tracing. The same document
+    the flight recorder dumps on failure (obs/flight.py)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from .core.log import get_logging
+
+    log = get_logging("thinvids_tpu.trace")
+    url = f"{args.coordinator.rstrip('/')}/trace/{args.job}"
+    try:
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            doc = json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        # surface the server's explanation (404 = unsampled job or
+        # ring-evicted trace) instead of a raw traceback
+        try:
+            detail = json.loads(exc.read()).get("error", "")
+        except Exception:   # noqa: BLE001 - body is best-effort
+            detail = ""
+        log.error("GET %s -> %d %s", url, exc.code, detail or exc.reason)
+        raise SystemExit(1)
+    except urllib.error.URLError as exc:
+        log.error("cannot reach coordinator at %s: %s",
+                  args.coordinator, exc.reason)
+        raise SystemExit(1)
+    out = args.out or f"{args.job}.trace.json"
+    with open(out, "w", encoding="utf-8") as fp:
+        json.dump(doc, fp)
+    events = doc.get("traceEvents", [])
+    other = doc.get("otherData", {})
+    log.info("wrote %d trace events (trace %s) to %s — open in "
+             "https://ui.perfetto.dev", len(events),
+             other.get("trace_id", "?"), out)
+
+
 def run_check(args: argparse.Namespace) -> None:
     """Static analysis over this repo (tools/check.py): jax/sync
     confinement, thread-safety audit, config discipline. jax-free and
@@ -285,6 +324,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pacing as a multiple of real time "
                         "(0 = as fast as possible)")
     f.set_defaults(fn=run_feed)
+
+    t = sub.add_parser("trace", help="export one job's distributed "
+                                     "trace as Chrome trace-event "
+                                     "JSON (Perfetto-loadable)")
+    t.add_argument("job", help="job id (see /jobs or the dashboard)")
+    t.add_argument("--coordinator",
+                   default=os.environ.get("TVT_COORDINATOR_URL",
+                                          "http://127.0.0.1:5005"))
+    t.add_argument("--out", default=None,
+                   help="output path (default <job>.trace.json)")
+    t.set_defaults(fn=run_trace)
 
     k = sub.add_parser("check", help="static analysis: jax/sync "
                                      "confinement, thread safety, "

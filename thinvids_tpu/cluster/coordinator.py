@@ -38,6 +38,9 @@ from ..core.config import Settings, get_settings, overlay_job_settings
 from ..core.events import ActivityLog
 from ..core.status import Status
 from ..core.types import VideoMeta
+from ..obs import flight as obs_flight
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from .jobs import Job, JobStore, new_run_token
 from .policy import evaluate_job_policy
 from .qos import QosController, job_rank
@@ -376,6 +379,9 @@ class Coordinator:
         `live_recover_parts` consecutive good parts."""
         if not self.token_is_current(job_id, token):
             return False
+        # the latency DISTRIBUTION the bench only spot-samples: every
+        # live part observes the fixed-bucket histogram
+        obs_metrics.LIVE_PART_SECONDS.observe(latency_s)
         recover = int(self._settings_fn().get("live_recover_parts", 2))
         event = self.qos.note_live_part(job_id, latency_s, budget_s,
                                         recover_parts=recover)
@@ -384,6 +390,16 @@ class Coordinator:
                 "qos", f"live part {latency_s:.2f}s over its "
                 f"{budget_s:.2f}s budget — preempting batch work",
                 job_id=job_id)
+            # postmortem artifact while the evidence is fresh: the
+            # breached job's spans + errors + settings
+            obs_trace.TRACE.record_error(
+                job_id, f"qos breach: live part {latency_s:.2f}s over "
+                        f"{budget_s:.2f}s budget")
+            obs_flight.record(
+                job_id, reason=f"qos preemption: live part "
+                               f"{latency_s:.2f}s over {budget_s:.2f}s "
+                               f"budget",
+                settings=self._settings_fn())
         elif event == "recovered":
             self.activity.emit(
                 "qos", "live edge recovered — batch work resumes",
@@ -451,6 +467,13 @@ class Coordinator:
         self.qos.clear_live(job_id)
         self.activity.emit("error", f"failed in {stage}: {reason}",
                            job_id=job_id, host=host)
+        # flight recorder: the failed job's recent spans + errors +
+        # settings dump beside the output tree so the postmortem does
+        # not depend on scraping logs (obs/flight.py; best-effort)
+        obs_trace.TRACE.record_error(job_id, f"{stage}: {reason}")
+        obs_flight.record(job_id,
+                          reason=f"job failed in {stage}: {reason}",
+                          settings=self._settings_fn())
 
     # ---- scheduler (capacity-gated dispatch) -------------------------
 
@@ -565,6 +588,10 @@ class Coordinator:
                 j.heartbeat_stage = "reserve"
             job = self.store.update(chosen.id, reserve)
             self._active_ids.add(job.id)
+        # fresh distributed trace per dispatch (a restart must not
+        # interleave spans with the old run); sampling decided here
+        # (trace_sample) — an unsampled job records nothing
+        obs_trace.TRACE.start(job.id)
         self.activity.emit("dispatch", "reserved for launch", job_id=job.id)
         if self._launcher is not None:
             self._launcher(job)

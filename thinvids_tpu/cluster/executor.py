@@ -27,6 +27,8 @@ from ..core.status import Status
 from ..ingest.decode import open_video
 from ..io.mp4 import mux_mp4
 from ..core.types import concat_segments
+from ..obs import flight as obs_flight
+from ..obs import trace as obs_trace
 from .coordinator import Coordinator
 from .jobs import Job
 
@@ -76,6 +78,9 @@ class LocalExecutor:
         #: test seam: (meta, settings, mesh) -> GopShardEncoder-like
         self._encoder_factory = encoder_factory or self._default_encoder
         self._threads: list[threading.Thread] = []
+        # flight-recorder artifacts (<job>.trace.json) land next to the
+        # output tree this executor writes (obs/flight.py)
+        obs_flight.configure(output_dir)
 
     # -- coordinator launcher interface --------------------------------
 
@@ -120,8 +125,16 @@ class LocalExecutor:
             max_segments=int(settings.max_segments))
 
     def run(self, job: Job) -> None:
-        co = self.coordinator
         token = job.run_token
+        # bind the job's trace context to this thread: spans record
+        # through the encoder's StageProfile + the wave loop below, and
+        # the structured JSON log mode stamps (job_id, trace_id) onto
+        # every line emitted while the run owns this thread
+        with obs_trace.bind(job.id, obs_trace.TRACE.trace_id(job.id)):
+            self._run_traced(job, token)
+
+    def _run_traced(self, job: Job, token: str) -> None:
+        co = self.coordinator
         # one-element list: the encode hook advances the stage marker in
         # place so failure attribution survives the subclass seam
         stage = ["probe"]
@@ -199,6 +212,7 @@ class LocalExecutor:
         co = self.coordinator
         stage[0] = "segment"
         enc = self._encoder_factory(meta, settings, self.mesh)
+        self._bind_trace(job, enc)
         plan = enc.plan(len(frames))
         co.update_progress(job.id, token, parts_total=plan.num_gops,
                            segment_progress=100.0)
@@ -240,6 +254,7 @@ class LocalExecutor:
             meta, rungs, mesh=self.mesh,
             gop_frames=int(settings.gop_frames),
             max_segments=int(settings.max_segments))
+        self._bind_trace(job, enc)
         plan = enc.plan(len(frames))
         co.update_progress(job.id, token, parts_total=plan.num_gops,
                            segment_progress=100.0)
@@ -338,6 +353,7 @@ class LocalExecutor:
         enc = LadderShardEncoder(
             meta, rungs, mesh=self.mesh, gop_frames=gop_n,
             max_segments=int(settings.max_segments))
+        self._bind_trace(job, enc)
         base = os.path.splitext(os.path.basename(job.input_path))[0]
         out_dir = os.path.join(self.output_dir, base + ".hls")
         os.makedirs(self.output_dir, exist_ok=True)
@@ -473,6 +489,18 @@ class LocalExecutor:
         except Exception:       # noqa: BLE001 - warm is best-effort;
             pass                # a real defect fails the REAL first
                                 # wave with proper attribution
+
+    def _bind_trace(self, job: Job, enc) -> None:
+        """Bind the job's span recorder to the encoder's stage profile:
+        every timed stage (decode/stage/dispatch/device_wait/fetch/
+        pack/concat, SFE per-frame) then records a span into the job's
+        distributed trace. Inert when the job was sampled out
+        (trace_sample) or the encoder is a test double without a
+        profile."""
+        stages = getattr(enc, "stages", None)
+        set_tracer = getattr(stages, "set_tracer", None)
+        if set_tracer is not None:
+            set_tracer(obs_trace.TRACE.recorder(job.id, host=self.host))
 
     def _emit_stage_breakdown(self, job: Job, enc) -> None:
         """Record the encoder's host-stage wall-clock breakdown (wave
@@ -667,6 +695,9 @@ class LocalExecutor:
         done = done0
         pending: deque = deque()        # (idx, staged, handle)
         attempts: dict[int, int] = {}
+        # per-wave spans in the job's distributed trace (inert when
+        # the job was sampled out — trace_sample)
+        rec = obs_trace.TRACE.recorder(job.id, host=self.host)
 
         def halt_check() -> None:
             if not co.token_is_current(job.id, token):
@@ -677,7 +708,8 @@ class LocalExecutor:
                 i, staged = next(staged_iter)
             except StopIteration:
                 return
-            pending.append((i, staged, enc.dispatch_wave(staged)))
+            with rec.span("wave_dispatch", wave=i):
+                pending.append((i, staged, enc.dispatch_wave(staged)))
 
         try:
             dispatch_next()
@@ -688,7 +720,8 @@ class LocalExecutor:
                     dispatch_next()     # overlap: depth-2 window, no more
                 i, staged, handle = pending.popleft()
                 try:
-                    segs = enc.collect_wave(handle)
+                    with rec.span("wave_collect", wave=i):
+                        segs = enc.collect_wave(handle)
                 except HaltedError:
                     raise
                 except Exception as exc:  # noqa: BLE001 - wave retry budget

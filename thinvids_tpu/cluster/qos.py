@@ -25,6 +25,8 @@ from __future__ import annotations
 import threading
 from typing import Callable
 
+from ..obs import metrics as obs_metrics
+
 #: priority classes, best first; `auto` derives from the job type
 PRIORITY_CLASSES = ("live", "ladder", "batch")
 _RANK = {"live": 0, "ladder": 1, "batch": 2}
@@ -108,6 +110,16 @@ class QosController:
                     event = "recovered"
                     if not self._breached:
                         self._batch_ok.set()
+            # gauge published UNDER the lock (the metric child's own
+            # leaf lock nests safely): racing events must not publish
+            # a stale value last
+            if event == "breach":
+                obs_metrics.QOS_BREACHES.inc()
+            elif event == "recovered":
+                obs_metrics.QOS_RECOVERIES.inc()
+            if event is not None:
+                obs_metrics.QOS_PREEMPTING.set(
+                    1 if self._breached else 0)
             cbs = list(self._preempt_cbs) if fire else []
         for cb in cbs:
             try:
@@ -117,6 +129,7 @@ class QosController:
             if n:
                 with self._lock:
                     self._preempted_shards += n
+                obs_metrics.QOS_PREEMPTED_SHARDS.inc(n)
         return event
 
     def clear_live(self, job_id: str) -> None:
@@ -127,6 +140,9 @@ class QosController:
             self._good_parts.pop(job_id, None)
             if not self._breached:
                 self._batch_ok.set()
+            # published under the lock — same rationale as
+            # note_live_part's gauge write
+            obs_metrics.QOS_PREEMPTING.set(1 if self._breached else 0)
 
     def batch_allowed(self) -> bool:
         return self._batch_ok.is_set()

@@ -48,6 +48,9 @@ from typing import Any, Callable, Iterable, Mapping
 from ..core.status import ShardState
 from ..core.types import (ChromaFormat, EncodedSegment, GopSpec, SegmentPlan,
                           VideoMeta)
+from ..obs import flight as obs_flight
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from .executor import HaltedError, LocalExecutor
 from .jobs import Job
 
@@ -148,6 +151,11 @@ class Shard:
     # claims hand out the best class first, and batch-rank shards are
     # requeued/eligibility-gated while a live job is over deadline
     priority: int = 2
+    # distributed-trace context (obs/trace): the job's trace id rides
+    # the claim descriptor to the worker, which echoes it back in the
+    # X-Tvt-Trace header on its /work uploads — a farm job's worker
+    # spans land in the SAME coordinator-side trace. "" = unsampled.
+    trace_id: str = ""
     state: ShardState = ShardState.PENDING
     attempt: int = 0                # completed (failed) attempts so far
     not_before: float = 0.0         # backoff gate for re-claims
@@ -192,6 +200,9 @@ class Shard:
         if self.rung:
             desc["rung"] = {"name": self.rung, "width": self.rung_width,
                             "height": self.rung_height}
+        if self.trace_id:
+            desc["trace"] = {"trace_id": self.trace_id,
+                             "job_id": self.job_id}
         return desc
 
 
@@ -414,6 +425,16 @@ class ShardBoard:
                 "attempt": shard.attempt + 1, "ts": now,
             })
             del self._recent[:-50]
+            job_id, elapsed = shard.job_id, shard.elapsed_s
+            assigned_at, gops = shard.assigned_at, len(shard.gops)
+        # coordinator-side shard span (lease → accepted part): the
+        # farm-level skeleton of the job's trace, which the worker's
+        # own uploaded spans then fill in. Board clocks are epoch
+        # (time.time) in production, matching the span timebase.
+        obs_metrics.SHARD_CLAIM_SECONDS.observe(max(0.0, elapsed))
+        obs_trace.TRACE.record_span(
+            job_id, "shard", t0=assigned_at or now, dur_s=elapsed,
+            host=host, tags={"shard": shard_id, "gops": gops})
         self.coordinator.registry.record_shard_result(host, ok=True)
         return True
 
@@ -461,6 +482,9 @@ class ShardBoard:
             f"shard {shard_id} attempt {attempt_no} on "
             f"{host or 'unknown'} failed: {error}",
             job_id=job_id, host=host)
+        obs_trace.TRACE.record_error(
+            job_id, f"shard {shard_id} attempt {attempt_no} on "
+                    f"{host or 'unknown'}: {error}")
         if host:
             streak = co.registry.record_shard_result(host, ok=False)
             if streak >= quarantine_after:
@@ -472,6 +496,13 @@ class ShardBoard:
                     "quarantine",
                     f"worker {host} quarantined after {streak} "
                     f"consecutive shard failures", host=host)
+                # postmortem artifact for the job the quarantine hit:
+                # its spans, the shard failures above, settings
+                obs_flight.record(
+                    job_id,
+                    reason=f"worker {host} quarantined after {streak} "
+                           f"consecutive shard failures",
+                    settings=self.coordinator._settings_fn())
 
     def requeue_expired(self) -> list[str]:
         """Lease sweep: requeue ASSIGNED shards whose deadline passed or
@@ -648,6 +679,7 @@ class RemoteExecutor(LocalExecutor):
         priority = job_rank(
             getattr(job, "job_type", "transcode"),
             str(settings.get("job_priority", "auto") or "auto"))
+        trace_id = obs_trace.TRACE.trace_id(job.id)
         for i in range(0, plan.num_gops, per_shard):
             gops = plan.gops[i:i + per_shard]
             shards.append(Shard(
@@ -663,7 +695,7 @@ class RemoteExecutor(LocalExecutor):
                 rung=rung.name if rung is not None else "",
                 rung_width=rung.width if rung is not None else 0,
                 rung_height=rung.height if rung is not None else 0,
-                priority=priority))
+                priority=priority, trace_id=trace_id))
         return shards
 
     def _build_shards(self, job: Job, meta, num_frames: int,
@@ -863,7 +895,7 @@ class RemoteExecutor(LocalExecutor):
 # ---------------------------------------------------------------------------
 
 
-def encode_shard(desc: Mapping[str, Any], frames, mesh=None
+def encode_shard(desc: Mapping[str, Any], frames, mesh=None, tracer=None
                  ) -> list[EncodedSegment]:
     """Encode one claimed shard on this process's devices. Pure w.r.t.
     the descriptor: the plan override pins the coordinator's exact GOP
@@ -882,7 +914,11 @@ def encode_shard(desc: Mapping[str, Any], frames, mesh=None
     transfer (TVT_COMPACT_TRANSFER), per-shard concurrent fetch, and
     the pack backend (TVT_PACK_BACKEND) — from its own environment;
     output stays bit-identical to the coordinator's plan regardless of
-    which transfer/pack path each worker takes (parity-tested)."""
+    which transfer/pack path each worker takes (parity-tested).
+
+    `tracer` (an obs/trace span sink — the daemon's SpanBuffer) binds
+    to the encoder's stage profile so the worker's decode/dispatch/
+    fetch/pack stages become spans in the job's distributed trace."""
     from ..parallel.dispatch import GopShardEncoder
 
     meta = meta_from_dict(desc["meta"])
@@ -907,6 +943,8 @@ def encode_shard(desc: Mapping[str, Any], frames, mesh=None
     else:
         enc = GopShardEncoder(meta, qp=int(desc["qp"]), mesh=mesh,
                               gop_frames=int(desc.get("gop_frames", 32)))
+    if tracer is not None:
+        enc.stages.set_tracer(tracer)
     enc.plan_override = SegmentPlan(
         gops=gops, num_devices=enc.num_devices,
         frames_per_gop=int(desc.get("gop_frames", 32)))
@@ -932,12 +970,19 @@ class WorkerClient:
         self.timeout_s = timeout_s
 
     def _request(self, path: str, data: bytes, content_type: str,
-                 timeout_s: float | None = None) -> dict[str, Any]:
+                 timeout_s: float | None = None,
+                 trace_id: str = "") -> dict[str, Any]:
         import urllib.request
 
+        headers = {"Content-Type": content_type}
+        if trace_id:
+            # the remote worker protocol's trace-context header —
+            # consumed by POST /work/spans, where the coordinator
+            # validates it against the job's LIVE trace and drops
+            # stale-run stragglers
+            headers["X-Tvt-Trace"] = trace_id
         req = urllib.request.Request(
-            self.base + path, data=data, method="POST",
-            headers={"Content-Type": content_type})
+            self.base + path, data=data, method="POST", headers=headers)
         with urllib.request.urlopen(
                 req, timeout=timeout_s or self.timeout_s) as resp:
             return json.loads(resp.read())
@@ -956,6 +1001,17 @@ class WorkerClient:
             # parts can be large; scale the budget, floor at the default
             timeout_s=max(self.timeout_s, 120.0))
         return bool(out.get("ok"))
+
+    def upload_spans(self, job_id: str, trace_id: str, host: str,
+                     spans: list[dict[str, Any]]) -> int:
+        """Ship a shard's collected spans to the coordinator's trace
+        ring (POST /work/spans, trace id in X-Tvt-Trace). Returns how
+        many the coordinator recorded."""
+        out = self._request(
+            "/work/spans", json.dumps({
+                "job_id": job_id, "host": host, "spans": spans,
+            }).encode(), "application/json", trace_id=trace_id)
+        return int(out.get("recorded", 0))
 
     def report_failure(self, shard_id: str, host: str, error: str) -> None:
         self._request("/work/status", json.dumps({
@@ -1029,27 +1085,54 @@ class WorkerDaemon:
 
     def step(self) -> bool:
         """One claim attempt. Returns True when a shard was processed
-        (successfully or not), False when the board had nothing."""
+        (successfully or not), False when the board had nothing.
+
+        When the claim descriptor carries a trace context, the shard's
+        worker-side spans (source open, encode incl. the encoder's
+        stage clocks, part upload) collect in a local SpanBuffer and
+        ship to the coordinator's trace ring afterwards — best-effort,
+        never part of the shard's success or failure."""
         shard = self.client.claim(self.host)
         if not shard:
             return False
+        trace = shard.get("trace") or {}
+        buf = obs_trace.SpanBuffer(
+            str(trace.get("trace_id", "")), str(trace.get("job_id", "")),
+            host=self.host) if trace.get("trace_id") else None
+        # inert recorder when untraced: span() is a no-op context, so
+        # the work loop below stays unconditional
+        sink = buf if buf is not None else obs_trace.NULL_RECORDER
         self.busy = True
         try:
-            frames = self._frames(shard["input_path"])
-            segments = encode_shard(shard, frames, mesh=self.mesh)
-            # the board may refuse the part (lease moved on, job gone):
-            # only an ACCEPTED part counts toward the done gauge
-            if self.client.upload_part(shard["id"], self.host, segments):
+            with sink.span("worker_shard", shard=shard["id"],
+                           attempt=shard.get("attempt", 0)):
+                with sink.span("open_source"):
+                    frames = self._frames(shard["input_path"])
+                segments = encode_shard(shard, frames, mesh=self.mesh,
+                                        tracer=buf)
+                # the board may refuse the part (lease moved on, job
+                # gone): only an ACCEPTED part counts toward the gauge
+                with sink.span("upload_part"):
+                    accepted = self.client.upload_part(
+                        shard["id"], self.host, segments)
+            if accepted:
                 self.shards_done += 1
         except Exception as exc:    # noqa: BLE001 - report, keep serving
             self.shards_failed += 1
             try:
                 self.client.report_failure(
-                    shard["id"], self.host, f"{type(exc).__name__}: {exc}")
+                    shard["id"], self.host,
+                    f"{type(exc).__name__}: {exc}")
             except Exception:       # noqa: BLE001 - coordinator gone;
                 pass                # the lease sweep requeues the shard
         finally:
             self.busy = False
+            if buf is not None:
+                try:
+                    self.client.upload_spans(
+                        buf.job_id, buf.trace_id, self.host, buf.drain())
+                except Exception:   # noqa: BLE001 - tracing is never
+                    pass            # allowed to fail the work loop
         return True
 
     def run_forever(self, stop: threading.Event | None = None) -> None:

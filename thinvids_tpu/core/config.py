@@ -100,6 +100,21 @@ DEFAULT_SETTINGS: dict[str, Any] = {
     "loadgen_duration_s": 10.0,
     "profile_dir": "",               # non-empty: jax.profiler trace of
                                      # the encode stage lands here
+                                     # (TVT_PROFILE_DIR — device-side
+                                     # drill-down beside the obs/ spans)
+    # observability (thinvids_tpu/obs/): metrics_enabled gates the
+    # GET /metrics Prometheus endpoint (TVT_METRICS_ENABLED; recording
+    # itself is always on — it is cheap and /metrics_snapshot reads the
+    # same counters); trace_sample (TVT_TRACE_SAMPLE, 0..1) decides PER
+    # JOB at dispatch whether its spans record at all; trace_ring_spans
+    # (TVT_TRACE_RING_SPANS) bounds each job's span ring on the
+    # coordinator; flight_record (TVT_FLIGHT_RECORD) gates the
+    # postmortem <job>.trace.json artifact on job failure / worker
+    # quarantine / QoS preemption.
+    "metrics_enabled": True,
+    "trace_sample": 1.0,
+    "trace_ring_spans": 4096,
+    "flight_record": True,
     # host wave pipeline (parallel/dispatch.py): slice-granular CAVLC
     # pack threads (0 = os.cpu_count()) and the in-flight wave window.
     # Deliberately independent: the pack pool sizes to the host's cores,
@@ -249,6 +264,11 @@ _CLAMPS: dict[str, Callable[[Any], Any]] = {
     "live_recover_parts": lambda v: min(100, max(1, as_int(v, 2))),
     "loadgen_sessions": lambda v: min(100_000, max(1, as_int(v, 500))),
     "loadgen_duration_s": lambda v: min(3600.0, max(0.5, as_float(v, 10.0))),
+    # a full-off sample (0.0) is legal: tracing costs nothing then
+    "trace_sample": lambda v: min(1.0, max(0.0, as_float(v, 1.0))),
+    # floor keeps at least a useful postmortem window; cap bounds the
+    # coordinator's per-job memory (a span dict is ~200 B)
+    "trace_ring_spans": lambda v: min(65536, max(256, as_int(v, 4096))),
     "pack_workers": lambda v: min(256, max(0, as_int(v, 0))),
     "pipeline_window": lambda v: min(64, max(1, as_int(v, 4))),
     "pack_backend": lambda v: str(v)

@@ -4,6 +4,12 @@ Port of the reference's idempotent shared logger
 (/root/reference/common.py:100-161): one root configuration, format with
 hostname + pid, ``TVT_LOG_LEVEL`` env override (legacy ``LOG_LEVEL``
 still honored), noisy third-party loggers quieted.
+
+``TVT_LOG_FORMAT=json`` switches every line to one structured JSON
+object (ts/level/logger/host/pid/msg, plus the active job and trace id
+when the emitting thread runs inside a traced job — obs/trace.bind),
+so farm logs can be machine-joined against ``GET /trace/<job>``
+exports instead of regex-scraped.
 """
 
 from __future__ import annotations
@@ -20,6 +26,50 @@ _FORMAT = (
 _QUIET = ("urllib3", "watchdog", "jax._src", "absl")
 
 
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line, stamped with the thread's ambient
+    (job_id, trace_id) when obs/trace.bind is active — the join key
+    between farm logs and the job's distributed trace."""
+
+    def __init__(self, host: str) -> None:
+        super().__init__()
+        self._host = host
+
+    def format(self, record: logging.LogRecord) -> str:
+        import json
+
+        doc = {
+            "ts": round(record.created, 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "host": self._host,
+            "pid": record.process,
+            "thread": record.threadName,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            doc["exc"] = self.formatException(record.exc_info)
+        try:
+            # lazy: core/log must stay importable before (and without)
+            # the obs package — e.g. from config-less tooling
+            from ..obs.trace import current_ids
+
+            ids = current_ids()
+        except Exception:   # noqa: BLE001 - never fail a log line
+            ids = None
+        if ids is not None:
+            doc["job_id"], doc["trace_id"] = ids
+        return json.dumps(doc, default=str)
+
+
+def _make_formatter(host: str) -> logging.Formatter:
+    """The formatter TVT_LOG_FORMAT selects: "json" = structured
+    one-object-per-line, anything else = the human text format."""
+    if os.environ.get("TVT_LOG_FORMAT", "").strip().lower() == "json":
+        return JsonFormatter(host)
+    return logging.Formatter(_FORMAT.format(host=host))
+
+
 def get_logging(name: str = "thinvids_tpu") -> logging.Logger:
     global _CONFIGURED
     if not _CONFIGURED:
@@ -30,9 +80,7 @@ def get_logging(name: str = "thinvids_tpu") -> logging.Logger:
             "TVT_LOG_LEVEL", os.environ.get("LOG_LEVEL", "INFO")).upper()
         level = getattr(logging, level_name, logging.INFO)
         handler = logging.StreamHandler()
-        handler.setFormatter(
-            logging.Formatter(_FORMAT.format(host=socket.gethostname()))
-        )
+        handler.setFormatter(_make_formatter(socket.gethostname()))
         root = logging.getLogger()
         root.setLevel(level)
         # Idempotent: only attach our handler if a TVT handler is absent.

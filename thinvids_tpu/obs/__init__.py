@@ -1,0 +1,28 @@
+"""Observability subsystem: metrics registry, distributed tracing,
+flight recorder.
+
+jax-free by contract (analysis manifest): every piece runs on control-
+plane threads — the coordinator's API, the executors' host loops, the
+worker daemons — never inside a device program. Three pillars:
+
+- :mod:`.metrics` — typed counters/gauges/histograms with label
+  support and Prometheus text exposition (``GET /metrics``). The
+  process-cumulative stage clocks, origin counters, QoS events and
+  shard-board state all land here; ``/metrics_snapshot`` stays as the
+  legacy JSON view.
+- :mod:`.trace` — per-job distributed traces: spans recorded on the
+  coordinator (and shipped back from remote workers over the
+  ``/work`` protocol with an ``X-Tvt-Trace`` header) into a bounded
+  per-job ring, exported as Chrome trace-event JSON
+  (``GET /trace/<job>``, ``cli.py trace`` — loadable in Perfetto).
+- :mod:`.flight` — postmortem flight recorder: on job failure, shard
+  quarantine or QoS preemption the job's recent spans + last errors +
+  settings snapshot dump as ``<job>.trace.json`` next to the output
+  tree.
+"""
+
+from __future__ import annotations
+
+from . import flight, metrics, trace  # noqa: F401
+
+__all__ = ["flight", "metrics", "trace"]

@@ -24,6 +24,8 @@ import threading
 from collections import OrderedDict
 from typing import Callable
 
+from ..obs import metrics as obs_metrics
+
 
 def strong_etag(data: bytes) -> str:
     """Strong ETag for an in-memory body (content-addressed, so it is
@@ -86,11 +88,16 @@ class HotSegmentCache:
             # larger budget admitted — eviction otherwise only runs on
             # the fill path, which a limit of 0 never reaches
             if self._entries:
+                evicted = 0
                 with self._lock:
                     while self._bytes > limit and self._entries:
                         _, old = self._entries.popitem(last=False)
                         self._bytes -= len(old.data)
                         self._evictions += 1
+                        evicted += 1
+                if evicted:
+                    obs_metrics.ORIGIN_COUNTERS[
+                        "origin_evictions"].inc(evicted)
             return None
         while True:
             with self._lock:
@@ -98,6 +105,7 @@ class HotSegmentCache:
                 if ent is not None:
                     self._entries.move_to_end(key)
                     self._hits += 1
+                    obs_metrics.ORIGIN_COUNTERS["origin_hits"].inc()
                     return ent
                 ev = self._filling.get(key)
                 if ev is None:
@@ -106,6 +114,8 @@ class HotSegmentCache:
                     filling = True
                 else:
                     self._coalesced += 1
+                    obs_metrics.ORIGIN_COUNTERS[
+                        "origin_coalesced_fills"].inc()
                     filling = False
             if not filling:
                 # herd member: wait for the filler, then re-check (the
@@ -121,6 +131,7 @@ class HotSegmentCache:
                 ev.set()
                 raise
             ent = CacheEntry(data, strong_etag(data))
+            evicted = 0
             with self._lock:
                 self._filling.pop(key, None)
                 self._fills += 1
@@ -131,6 +142,11 @@ class HotSegmentCache:
                         _, old = self._entries.popitem(last=False)
                         self._bytes -= len(old.data)
                         self._evictions += 1
+                        evicted += 1
+            obs_metrics.ORIGIN_COUNTERS["origin_fills"].inc()
+            if evicted:
+                obs_metrics.ORIGIN_COUNTERS[
+                    "origin_evictions"].inc(evicted)
             ev.set()
             return ent
 

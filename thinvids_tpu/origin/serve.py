@@ -26,6 +26,7 @@ import threading
 import time
 from typing import Callable
 
+from ..obs import metrics as obs_metrics
 from .cache import HotSegmentCache, stat_etag
 
 
@@ -156,6 +157,9 @@ class OriginStats:
     def bump(self, key: str, n: int = 1) -> None:
         with self._lock:
             self._counts[key] = self._counts.get(key, 0) + n
+        metric = obs_metrics.ORIGIN_COUNTERS.get(key)
+        if metric is not None:
+            metric.inc(n)
 
     def snapshot(self) -> dict:
         with self._lock:

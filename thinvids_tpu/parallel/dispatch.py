@@ -70,6 +70,11 @@ from collections import deque
 from ..core.config import as_bool, get_settings
 from ..core.devices import shard_map
 from ..core.log import get_logging
+# jax-free observability layer: the process-cumulative stage totals
+# bridge into the Prometheus registry, and a bound span recorder (the
+# executor wires one per traced job) turns every timed stage into a
+# span in the job's distributed trace
+from ..obs import metrics as obs_metrics
 from ..core.types import (BandPlan, ChromaFormat, EncodedSegment, Frame,
                           GopSpec, SegmentPlan, VideoMeta)
 from ..codecs.h264 import jaxcore
@@ -136,16 +141,40 @@ class StageProfile:
     garbage-collected; reset() only clears THIS profile (bench resets
     per timed pass without zeroing the process counters)."""
 
-    def __init__(self, mirror: "StageProfile | None" = None) -> None:
+    def __init__(self, mirror: "StageProfile | None" = None,
+                 metrics: bool = False) -> None:
         self._lock = threading.Lock()
         self._ms = {k: 0.0 for k in STAGE_NAMES}
         self._counts = {k: 0 for k in STAGE_COUNTERS}
         self._waves = 0
         self._mirror = mirror
+        #: bridge into the obs/ metrics registry — set ONLY on the
+        #: process-cumulative _TOTALS instance, so every add lands in
+        #: the registry exactly once (per-encoder profiles mirror into
+        #: _TOTALS, which forwards)
+        self._metrics = bool(metrics)
+        #: optional span recorder (obs/trace): the executor binds one
+        #: per traced job so each timed stage also records a span in
+        #: the job's distributed trace. None = zero tracing overhead.
+        self._tracer = None
+
+    def set_tracer(self, recorder) -> None:
+        """Bind (or clear, with None/an inert recorder) the span sink
+        this profile's stage() blocks record into."""
+        with self._lock:
+            self._tracer = recorder if recorder is not None \
+                and getattr(recorder, "enabled", False) else None
+
+    def tracer(self):
+        """The bound span recorder, or None (instrumentation sites
+        that record spans outside a stage() block read this)."""
+        return self._tracer
 
     def add(self, stage: str, seconds: float) -> None:
         with self._lock:
             self._ms[stage] = self._ms.get(stage, 0.0) + seconds * 1e3
+        if self._metrics:
+            obs_metrics.STAGE_SECONDS.labels(stage).inc(seconds)
         if self._mirror is not None:
             self._mirror.add(stage, seconds)
 
@@ -153,20 +182,31 @@ class StageProfile:
         """Increment a monotonic counter (STAGE_COUNTERS) by `n`."""
         with self._lock:
             self._counts[counter] = self._counts.get(counter, 0) + int(n)
+        if self._metrics:
+            metric = obs_metrics.STAGE_COUNTER_TOTALS.get(counter)
+            if metric is not None:
+                metric.inc(n)
         if self._mirror is not None:
             self._mirror.bump(counter, n)
 
     @contextlib.contextmanager
-    def stage(self, name: str):
+    def stage(self, name: str, **tags):
+        tracer = self._tracer
+        t0_wall = time.time() if tracer is not None else 0.0
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            self.add(name, time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self.add(name, dt)
+            if tracer is not None:
+                tracer.record(name, t0_wall, dt, **tags)
 
     def count_wave(self) -> None:
         with self._lock:
             self._waves += 1
+        if self._metrics:
+            obs_metrics.WAVES_TOTAL.inc()
         if self._mirror is not None:
             self._mirror.count_wave()
 
@@ -186,8 +226,9 @@ class StageProfile:
             self._waves = 0
 
 
-#: process-cumulative stage totals (every encoder mirrors into this)
-_TOTALS = StageProfile()
+#: process-cumulative stage totals (every encoder mirrors into this;
+#: the metrics flag bridges each add into the obs/ Prometheus registry)
+_TOTALS = StageProfile(metrics=True)
 
 
 def stage_snapshot() -> dict:
@@ -195,6 +236,32 @@ def stage_snapshot() -> dict:
     here (the /metrics_snapshot exporter — running jobs' waves land as
     they complete, and finished jobs' totals persist)."""
     return _TOTALS.snapshot()
+
+
+#: process-cumulative SFE per-frame latency samples (ms) — the gaps
+#: between consecutive frames' bitstream-ready times across every
+#: SfeShardEncoder that ran here. The data frame_done_t always
+#: recorded, finally summarized: /metrics_snapshot and the dashboard
+#: surface p50/p99 from this ring, and each sample also observes the
+#: tvt_sfe_frame_latency_seconds histogram.
+_SFE_LAT_MS: deque = deque(maxlen=4096)
+#: guards ring iteration vs the collector threads' appends (a deque
+#: mutated mid-iteration raises RuntimeError — the snapshot endpoint
+#: must not 500 exactly while an SFE job is hot)
+_SFE_LAT_LOCK = threading.Lock()
+
+
+def frame_latency_percentiles() -> dict:
+    """{"p50_ms", "p99_ms", "count"} over the recent SFE per-frame
+    latency ring; {} when no SFE frame ever completed here."""
+    with _SFE_LAT_LOCK:
+        samples = sorted(_SFE_LAT_MS)
+    pct = obs_metrics.percentiles(samples, {"p50_ms": 0.50,
+                                            "p99_ms": 0.99})
+    if not pct:
+        return {}
+    return {k: round(v, 1) for k, v in pct.items()} \
+        | {"count": len(samples)}
 
 
 class _FrameCursor:
@@ -971,12 +1038,12 @@ class GopShardEncoder:
         # the wave's compute does, splitting "waiting on the device"
         # from the bulk D2H fetch in the stage breakdown — and letting
         # a budget overflow skip the bulk sparse fetch entirely.
-        t0 = time.perf_counter()
-        if self.inter:
-            tiny = jax.device_get(list(out[2:6] if compact else out[2:5]))
-        else:
-            tiny = jax.device_get([out[0], out[1]])
-        prof.add("device_wait", time.perf_counter() - t0)
+        with prof.stage("device_wait"):
+            if self.inter:
+                tiny = jax.device_get(list(out[2:6] if compact
+                                           else out[2:5]))
+            else:
+                tiny = jax.device_get([out[0], out[1]])
         prof.bump("d2h_bytes", sum(int(a.nbytes) for a in tiny))
         flat = None
         used = payload_rows = None
@@ -1399,6 +1466,12 @@ class SfeShardEncoder(GopShardEncoder):
         #: only the most recent window survives (enough for any
         #: latency percentile; bench clears it per timed pass anyway).
         self.frame_done_t: deque = deque(maxlen=4096)
+        #: previous frame's bitstream-ready perf_counter — the source
+        #: of the per-frame latency gap fed to the process-global
+        #: _SFE_LAT_MS ring + the tvt_sfe_frame_latency_seconds
+        #: histogram (concurrent collectors append near-order; a
+        #: benign race here only drops/shifts one sample)
+        self._last_frame_done: float | None = None
         #: test hook: device_get each frame's recon carry into
         #: `recon_frames` (absolute frame index → display-cropped
         #: y/u/v) for conformance parity against an independent decode
@@ -1466,6 +1539,16 @@ class SfeShardEncoder(GopShardEncoder):
             cursor.release_below(gop.end_frame)
 
     # -- device steps ---------------------------------------------------
+
+    def encode_waves(self, waves, window: int | None = None,
+                     pack_workers: int | None = None):
+        # fresh latency baseline per encode pass: the idle gap since a
+        # PREVIOUS pass's last frame is not a per-frame latency and
+        # must not become the reported p99 (bench reuses one encoder
+        # across warmup + timed passes)
+        self._last_frame_done = None
+        return super().encode_waves(waves, window=window,
+                                    pack_workers=pack_workers)
 
     def _step_mesh(self) -> Mesh | None:
         """None on a single band: the per-band program runs without the
@@ -1577,6 +1660,27 @@ class SfeShardEncoder(GopShardEncoder):
             return [t() for t in thunks]
         return [f.result() for f in [pool.submit(t) for t in thunks]]
 
+    def _note_frame_done(self, frame_index: int) -> None:
+        """One SFE frame's bitstream is ready: stamp frame_done_t (the
+        bench's latency source), count it, and — when a previous frame
+        exists — record the steady-state gap as a latency sample
+        (global percentile ring + histogram) and a `sfe_frame` span in
+        the job's trace."""
+        now = time.perf_counter()
+        prev, self._last_frame_done = self._last_frame_done, now
+        self.stages.bump("sfe_frames")
+        self.frame_done_t.append(now)
+        if prev is None or now <= prev:
+            return
+        gap = now - prev
+        with _SFE_LAT_LOCK:
+            _SFE_LAT_MS.append(gap * 1e3)
+        obs_metrics.SFE_FRAME_SECONDS.observe(gap)
+        tracer = self.stages.tracer()
+        if tracer is not None:
+            tracer.record("sfe_frame", time.time() - gap, gap,
+                          frame=frame_index)
+
     def _keep_recon(self, carry, frame_index: int) -> None:
         ry, ru, rv = jax.device_get(carry[:3])
         h, w = self.meta.height, self.meta.width
@@ -1608,9 +1712,8 @@ class SfeShardEncoder(GopShardEncoder):
         dense_from = None
         for fi, out in enumerate(outs):
             head, nblk, nval, n_esc, used, payload = out
-            t0 = time.perf_counter()
-            tiny = jax.device_get([nblk, nval, n_esc, used])
-            prof.add("device_wait", time.perf_counter() - t0)
+            with prof.stage("device_wait"):
+                tiny = jax.device_get([nblk, nval, n_esc, used])
             prof.bump("d2h_bytes", sum(int(a.nbytes) for a in tiny))
             nblk_h, nval_h, nesc_h, used_h = tiny
             if int(np.asarray(nesc_h).max()) > 0:
@@ -1641,8 +1744,7 @@ class SfeShardEncoder(GopShardEncoder):
                 frame_nal = self.sps.to_nal() + self.pps.to_nal() \
                     + frame_nal
             nals.append(frame_nal)
-            prof.bump("sfe_frames")
-            self.frame_done_t.append(time.perf_counter())
+            self._note_frame_done(gop.start_frame + fi)
             if self.keep_recon:
                 self._keep_recon(carries[fi], gop.start_frame + fi)
         if dense_from is not None:
@@ -1706,8 +1808,7 @@ class SfeShardEncoder(GopShardEncoder):
                     frame_nal = self.sps.to_nal() + self.pps.to_nal() \
                         + frame_nal
                 nals.append(frame_nal)
-                prof.bump("sfe_frames")
-                self.frame_done_t.append(time.perf_counter())
+                self._note_frame_done(gop.start_frame + fi)
                 if self.keep_recon:
                     self._keep_recon(carry, gop.start_frame + fi)
         return nals
