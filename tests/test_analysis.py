@@ -11,14 +11,20 @@ Two layers:
    part of the tree it scans AND of the manifest's jax-free set.
 """
 
+import dataclasses
+import json
 import os
 import subprocess
 import sys
 
+import pytest
+
 from thinvids_tpu.analysis import (Manifest, SourceTree, apply_waivers,
                                    default_manifest, run_all)
-from thinvids_tpu.analysis import configcheck, imports, syncs, threads
+from thinvids_tpu.analysis import (configcheck, imports, jitcheck,
+                                   statemachine, syncs, threads)
 from thinvids_tpu.analysis.astutil import matches_any
+from thinvids_tpu.analysis.manifest import StateMachine
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG_DIR = os.path.join(REPO, "thinvids_tpu")
@@ -334,6 +340,619 @@ class TestConfigPass:
         found = configcheck.check_raw_access(tree,
                                              Manifest(package="fixpkg"))
         assert codes(found) == ["TVT-C003", "TVT-C003"]
+
+
+# ---------------------------------------------------------------------------
+# pass 3b: guarded-by inference + cross-object lock order
+# ---------------------------------------------------------------------------
+
+
+class TestLocksetPass:
+    def test_writes_under_different_locks(self, tmp_path):
+        """TVT-T004a: both writers hold A lock — no, one holds _a_lock
+        and one _b_lock; the lockset intersection is empty, so neither
+        lock actually protects the field."""
+        tree = make_pkg(tmp_path, {"s.py": (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._a_lock = threading.Lock()\n"
+            "        self._b_lock = threading.Lock()\n"
+            "        self.n = 0\n"
+            "        self._thread = None\n"
+            "    def start(self):\n"
+            "        self._thread = threading.Thread(target=self._loop)\n"
+            "    def _loop(self):\n"
+            "        with self._a_lock:\n"
+            "            self.n += 1\n"
+            "    def bump(self):\n"
+            "        with self._b_lock:\n"
+            "            self.n += 1\n")})
+        found = threads.run(tree, Manifest(package="fixpkg"))
+        assert codes(found) == ["TVT-T004"]
+        assert "DIFFERENT locks" in found[0].message
+
+    def test_consistent_single_lock_is_clean(self, tmp_path):
+        tree = make_pkg(tmp_path, {"c.py": _LOCKED})
+        assert threads.run(tree, Manifest(package="fixpkg")) == []
+
+    def test_declared_guarded_by_read_without_lock(self, tmp_path):
+        """TVT-T004b: a manifest-declared guarded field must hold its
+        lock at EVERY read/write site (not just writes)."""
+        tree = make_pkg(tmp_path, {"store.py": (
+            "import threading\n"
+            "class Store:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._jobs = {}\n"
+            "    def put(self, k, v):\n"
+            "        with self._lock:\n"
+            "            self._jobs[k] = v\n"
+            "    def peek(self):\n"
+            "        return len(self._jobs)\n"
+            "    def _find_locked(self, k):\n"
+            "        return self._jobs.get(k)\n")})
+        m = Manifest(package="fixpkg",
+                     guarded_by={"fixpkg.store:Store._jobs": "_lock"})
+        found = threads.run(tree, m)
+        # peek() reads it unlocked; _find_locked is caller-holds-lock
+        assert codes(found) == ["TVT-T004"]
+        assert "peek" in found[0].message
+
+    def test_cross_object_lock_cycle(self, tmp_path):
+        """TVT-T005: Board holds its lock and calls into Manager
+        (which takes _mgr_lock); Manager holds _mgr_lock and calls
+        back into Board (which takes _lock) — a cross-object
+        inversion, resolved through __init__ construction sites and
+        parameter annotations."""
+        tree = make_pkg(tmp_path, {"x.py": (
+            "import threading\n"
+            "class Board:\n"
+            "    def __init__(self, mgr: 'Manager'):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.mgr = mgr\n"
+            "    def poke(self):\n"
+            "        with self._lock:\n"
+            "            self.mgr.note()\n"
+            "    def count(self):\n"
+            "        with self._lock:\n"
+            "            return 1\n"
+            "class Manager:\n"
+            "    def __init__(self):\n"
+            "        self._mgr_lock = threading.Lock()\n"
+            "        self.board = Board(self)\n"
+            "    def note(self):\n"
+            "        with self._mgr_lock:\n"
+            "            pass\n"
+            "    def drain(self):\n"
+            "        with self._mgr_lock:\n"
+            "            self.board.count()\n")})
+        found = threads.run(tree, Manifest(package="fixpkg"))
+        assert "TVT-T005" in codes(found)
+        t5 = next(f for f in found if f.code == "TVT-T005")
+        assert "cross-object" in t5.message
+
+    def test_released_lock_does_not_fabricate_cross_edges(self, tmp_path):
+        """Cross-object edges use the locks held AT the call site, not
+        every lock the caller ever acquires: here _b_lock is acquired
+        and RELEASED before the Manager call happens under _a_lock
+        only, so there is no Board._b_lock → Manager._mgr_lock edge
+        and no cycle with Manager's _mgr_lock → Board._b_lock path."""
+        tree = make_pkg(tmp_path, {"z.py": (
+            "import threading\n"
+            "class Board:\n"
+            "    def __init__(self, mgr: 'Manager'):\n"
+            "        self._a_lock = threading.Lock()\n"
+            "        self._b_lock = threading.Lock()\n"
+            "        self.mgr = mgr\n"
+            "    def poke(self):\n"
+            "        with self._b_lock:\n"
+            "            pass\n"
+            "        with self._a_lock:\n"
+            "            self._note_locked()\n"
+            "    def _note_locked(self):\n"
+            "        self.mgr.note()\n"
+            "    def grab_b(self):\n"
+            "        with self._b_lock:\n"
+            "            return 1\n"
+            "class Manager:\n"
+            "    def __init__(self):\n"
+            "        self._mgr_lock = threading.Lock()\n"
+            "        self.board = Board(self)\n"
+            "    def note(self):\n"
+            "        with self._mgr_lock:\n"
+            "            pass\n"
+            "    def drain(self):\n"
+            "        with self._mgr_lock:\n"
+            "            self.board.grab_b()\n")})
+        found = threads.run(tree, Manifest(package="fixpkg"))
+        assert not [f for f in found
+                    if f.code in ("TVT-T003", "TVT-T005")], \
+            [f.format() for f in found]
+
+    def test_same_named_classes_both_audited(self, tmp_path):
+        """A second same-named class in one module (factory-local)
+        must not shadow the first out of the audit: the top-level
+        Worker's unlocked cross-thread write is still reported."""
+        tree = make_pkg(tmp_path, {"w.py": (
+            "import threading\n"
+            "class Worker:\n"
+            "    def __init__(self):\n"
+            "        self.n = 0\n"
+            "        self._thread = None\n"
+            "    def start(self):\n"
+            "        self._thread = threading.Thread(target=self._loop)\n"
+            "    def _loop(self):\n"
+            "        self.n += 1\n"
+            "    def bump(self):\n"
+            "        self.n += 1\n"
+            "def make():\n"
+            "    class Worker:\n"
+            "        def quiet(self):\n"
+            "            return 1\n"
+            "    return Worker()\n")})
+        found = threads.run(tree, Manifest(package="fixpkg"))
+        assert "TVT-T001" in codes(found)
+        assert any("Worker.n" in f.message for f in found)
+
+    def test_guarded_read_and_write_keys_are_distinct(self, tmp_path):
+        """One method that both reads AND writes a guarded field
+        unlocked yields two findings under DIFFERENT waiver keys — one
+        waiver must not silently suppress both debts."""
+        tree = make_pkg(tmp_path, {"store.py": (
+            "import threading\n"
+            "class Store:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._jobs = {}\n"
+            "    def locked_put(self, k, v):\n"
+            "        with self._lock:\n"
+            "            self._jobs[k] = v\n"
+            "    def swap(self, other):\n"
+            "        old = self._jobs\n"
+            "        self._jobs = other\n"
+            "        return old\n")})
+        m = Manifest(package="fixpkg",
+                     guarded_by={"fixpkg.store:Store._jobs": "_lock"})
+        found = threads.run(tree, m)
+        t4 = [f for f in found if f.code == "TVT-T004"]
+        assert len(t4) == 2
+        assert len({f.key for f in t4}) == 2
+
+    def test_local_alias_chain_is_followed(self, tmp_path):
+        """`reg = self.co.registry; reg.beat()` under a held lock must
+        contribute the same cross-object edge as the direct chain (the
+        ShardBoard→WorkerRegistry shape)."""
+        tree = make_pkg(tmp_path, {"y.py": (
+            "import threading\n"
+            "class Registry:\n"
+            "    def __init__(self, board: 'Board'):\n"
+            "        self._reg_lock = threading.Lock()\n"
+            "        self.board = board\n"
+            "    def beat(self):\n"
+            "        with self._reg_lock:\n"
+            "            pass\n"
+            "    def scan(self):\n"
+            "        with self._reg_lock:\n"
+            "            self.board.depth()\n"
+            "class Co:\n"
+            "    def __init__(self, board: 'Board'):\n"
+            "        self.registry = Registry(board)\n"
+            "class Board:\n"
+            "    def __init__(self, co: 'Co'):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.co = co\n"
+            "    def claim(self):\n"
+            "        with self._lock:\n"
+            "            reg = self.co.registry\n"
+            "            reg.beat()\n"
+            "    def depth(self):\n"
+            "        with self._lock:\n"
+            "            return 0\n")})
+        found = threads.run(tree, Manifest(package="fixpkg"))
+        # Board._lock -> Registry._reg_lock via the LOCAL ALIAS
+        # (`reg = self.co.registry; reg.beat()`), closed by scan()'s
+        # direct `self.board.depth()` chain
+        assert "TVT-T005" in codes(found)
+
+
+# ---------------------------------------------------------------------------
+# pass 5: protocol state machines (TVT-M001 audit + TVT-M002 model)
+# ---------------------------------------------------------------------------
+
+FIX_MACHINE = StateMachine(
+    name="fix", enum="St", attr="state", scope=("fixpkg",),
+    states=("A", "B", "C"), initial=("A",),
+    transitions=(("A", "B"), ("B", "C")),
+    predicates={"is_open": ("A", "B")})
+
+_ST = "class St:\n    A = 'a'\n    B = 'b'\n    C = 'c'\n"
+
+
+class TestStateMachineAudit:
+    def manifest(self, machine=FIX_MACHINE):
+        return Manifest(package="fixpkg", state_machines=(machine,))
+
+    def test_unguarded_write_flags_undeclared_edges(self, tmp_path):
+        tree = make_pkg(tmp_path, {"m.py": _ST + (
+            "def f(o):\n"
+            "    o.state = St.C\n")})
+        found = statemachine.audit_transitions(tree, self.manifest())
+        assert codes(found) == ["TVT-M001"]
+        # B->C is declared; A->C and C->C are the undeclared sources
+        assert "A" in found[0].message and "St.C" in found[0].message
+
+    def test_is_guard_narrows_to_declared_edge(self, tmp_path):
+        tree = make_pkg(tmp_path, {"m.py": _ST + (
+            "def f(o):\n"
+            "    if o.state is not St.A:\n"
+            "        return\n"
+            "    o.state = St.B\n"
+            "def g(o):\n"
+            "    if o.state is St.B:\n"
+            "        o.state = St.C\n")})
+        assert statemachine.audit_transitions(tree, self.manifest()) == []
+
+    def test_predicate_guard_narrows(self, tmp_path):
+        machine = dataclasses.replace(
+            FIX_MACHINE, transitions=(("A", "C"), ("B", "C")))
+        tree = make_pkg(tmp_path, {"m.py": _ST + (
+            "def f(o):\n"
+            "    if not o.state.is_open:\n"
+            "        return\n"
+            "    o.state = St.C\n")})
+        assert statemachine.audit_transitions(
+            tree, self.manifest(machine)) == []
+        # without the guard, C->C is reachable and undeclared
+        tree2 = make_pkg(tmp_path, {"m.py": _ST + (
+            "def f(o):\n"
+            "    o.state = St.C\n")}, name="fixpkg2")
+        m2 = Manifest(package="fixpkg2", state_machines=(
+            dataclasses.replace(machine, scope=("fixpkg2",)),))
+        found = statemachine.audit_transitions(tree2, m2)
+        assert codes(found) == ["TVT-M001"]
+
+    def test_membership_guard_and_branches(self, tmp_path):
+        tree = make_pkg(tmp_path, {"m.py": _ST + (
+            "def f(o):\n"
+            "    if o.state not in (St.A, St.B):\n"
+            "        return\n"
+            "    if o.state is St.A:\n"
+            "        o.state = St.B\n"
+            "    else:\n"
+            "        o.state = St.C\n")})
+        assert statemachine.audit_transitions(tree, self.manifest()) == []
+
+    def test_setattr_write_site_is_audited(self, tmp_path):
+        tree = make_pkg(tmp_path, {"m.py": _ST + (
+            "def f(o):\n"
+            "    setattr(o, 'state', St.B)\n")})
+        found = statemachine.audit_transitions(tree, self.manifest())
+        assert codes(found) == ["TVT-M001"]
+
+    def test_lambda_write_site_is_audited(self, tmp_path):
+        tree = make_pkg(tmp_path, {"m.py": _ST + (
+            "def f(store, oid):\n"
+            "    store.update(oid, lambda o: setattr(o, 'state', St.B))\n"
+        )})
+        found = statemachine.audit_transitions(tree, self.manifest())
+        assert codes(found) == ["TVT-M001"]
+
+    def test_loop_guard_with_continue(self, tmp_path):
+        # the ShardBoard.report_failure shape: guard-exit inside a loop
+        tree = make_pkg(tmp_path, {"m.py": _ST + (
+            "def sweep(objs):\n"
+            "    for o in objs:\n"
+            "        if o.state is not St.B:\n"
+            "            continue\n"
+            "        o.state = St.C\n")})
+        assert statemachine.audit_transitions(tree, self.manifest()) == []
+
+    def test_bad_initial_default(self, tmp_path):
+        # both the dataclass AnnAssign form and a plain class-body
+        # Assign must hit the initial-state check
+        tree = make_pkg(tmp_path, {"m.py": _ST + (
+            "class Obj:\n"
+            "    state: str = St.B\n"
+            "class Obj2:\n"
+            "    state = St.C\n")})
+        found = statemachine.audit_transitions(tree, self.manifest())
+        assert codes(found) == ["TVT-M001", "TVT-M001"]
+        assert all("initial" in f.message for f in found)
+
+    def test_annotated_assignment_is_audited(self, tmp_path):
+        # `o.state: St = St.C` must not bypass the write audit
+        tree = make_pkg(tmp_path, {"m.py": _ST + (
+            "def f(o):\n"
+            "    o.state: str = St.C\n")})
+        found = statemachine.audit_transitions(tree, self.manifest())
+        assert codes(found) == ["TVT-M001"]
+
+    def test_dynamic_setattr_attr_name_is_audited(self, tmp_path):
+        # a machine-enum VALUE written through a variable attribute
+        # name is unauditable — treated as a write of the attr, so an
+        # unguarded site still fails
+        tree = make_pkg(tmp_path, {"m.py": _ST + (
+            "def f(o, field):\n"
+            "    setattr(o, field, St.C)\n")})
+        found = statemachine.audit_transitions(tree, self.manifest())
+        assert codes(found) == ["TVT-M001"]
+
+
+class TestBoardModel:
+    """TVT-M002: the bounded explorer over the ShardBoard model —
+    clean on the declared table, and every seeded mutation produces a
+    deterministic counterexample naming the violated invariant and
+    the interleaving."""
+
+    def test_clean_model_exercises_exactly_the_declared_table(self):
+        m = default_manifest()
+        violations, edges = statemachine.check_model(m)
+        assert violations == []
+        shard = next(mm for mm in m.state_machines
+                     if mm.name == "shard")
+        assert edges == set(shard.transitions)
+
+    def test_model_findings_clean_on_declared_manifest(self):
+        assert statemachine.model_findings(default_manifest()) == []
+
+    def test_stale_table_is_a_finding(self):
+        m = default_manifest()
+        shard = next(mm for mm in m.state_machines
+                     if mm.name == "shard")
+        bloated = dataclasses.replace(
+            shard, transitions=shard.transitions + (("DONE", "FAILED"),))
+        m2 = dataclasses.replace(
+            m, state_machines=(bloated,)
+            + tuple(mm for mm in m.state_machines
+                    if mm.name != "shard"))
+        found = statemachine.model_findings(m2)
+        assert codes(found) == ["TVT-M002"]
+        assert "stale" in found[0].message
+
+    @pytest.mark.parametrize("mutation,invariant", [
+        ("double_assign", "single-assignment"),
+        ("preempt_burns_attempt", "attempt-accounting"),
+        ("accept_after_done", "done-absorbs"),
+        ("no_token_fence", "token-fence"),
+        ("collect_partial", "collect-all-done"),
+        ("shared_ids", "cross-run-part"),
+        ("no_expiry", "open-shard-unreachable"),
+        ("gate_ignored", "qos-gate"),
+    ])
+    def test_seeded_mutation_yields_counterexample(self, mutation,
+                                                   invariant):
+        violations, _ = statemachine.check_model(
+            default_manifest(), mutations=(mutation,))
+        assert violations, f"mutation {mutation} went undetected"
+        v = violations[0]
+        assert v.invariant == invariant
+        # the counterexample names the interleaving
+        assert "interleaving:" in v.format()
+        assert v.trace
+
+    def test_counterexample_is_deterministic(self):
+        runs = [statemachine.check_model(default_manifest(),
+                                         mutations=("shared_ids",))[0]
+                for _ in range(2)]
+        assert [(v.invariant, v.trace) for v in runs[0]] == \
+            [(v.invariant, v.trace) for v in runs[1]]
+
+    def test_unknown_mutation_rejected(self):
+        with pytest.raises(ValueError):
+            statemachine.BoardModel(statemachine.ModelConfig(),
+                                    mutations=("bogus",))
+
+
+# ---------------------------------------------------------------------------
+# pass 6: jit/retrace discipline
+# ---------------------------------------------------------------------------
+
+
+class TestJitPass:
+    def test_stray_jit_outside_declared_modules(self, tmp_path):
+        tree = make_pkg(tmp_path, {"stray.py": (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x\n")})
+        m = Manifest(package="fixpkg", jit_modules=("fixpkg.dev",),
+                     hot_loops=())
+        found = jitcheck.run(tree, m)
+        assert codes(found) == ["TVT-X001"]
+
+    def test_unquantized_dynamic_slice_bound(self, tmp_path):
+        tree = make_pkg(tmp_path, {"dev.py": (
+            "def fetch(payload, used):\n"
+            "    a = payload[:, :used.max()]\n"
+            "    n = int(used.max())\n"
+            "    b = payload[:, :n]\n"
+            "    return a, b\n")})
+        m = Manifest(package="fixpkg", jit_modules=("fixpkg.dev",),
+                     hot_loops=())
+        found = jitcheck.run(tree, m)
+        # one finding per function: both bounds are the same fix
+        assert codes(found) == ["TVT-X001"]
+        assert "quantizer" in found[0].message
+
+    def test_taint_survives_unpack_and_annotated_assign(self, tmp_path):
+        tree = make_pkg(tmp_path, {"dev.py": (
+            "def a(payload, lens):\n"
+            "    used, z = lens.max(), 0\n"
+            "    return payload[:, :used]\n"
+            "def b(payload, lens):\n"
+            "    used: int = lens.max()\n"
+            "    return payload[:, :used]\n")})
+        m = Manifest(package="fixpkg", jit_modules=("fixpkg.dev",),
+                     hot_loops=())
+        found = jitcheck.run(tree, m)
+        assert codes(found) == ["TVT-X001", "TVT-X001"]
+
+    def test_quantized_slice_is_clean(self, tmp_path):
+        tree = make_pkg(tmp_path, {"dev.py": (
+            "def fetch(payload, used, cut):\n"
+            "    mu = cut(used.max())\n"
+            "    return payload[:, :cut(used.max())], payload[:, :mu]\n"
+        )})
+        m = Manifest(package="fixpkg", jit_modules=("fixpkg.dev",),
+                     hot_loops=())
+        assert jitcheck.run(tree, m) == []
+
+    def test_nested_function_audited_once_with_own_taint(self, tmp_path):
+        """A nested def is its own taint scope: the enclosing
+        function's dynamic `used` must not leak into `inner`, whose
+        parameter of the same name is an unknown (clean) value."""
+        tree = make_pkg(tmp_path, {"dev.py": (
+            "def outer(payload, lens):\n"
+            "    used = lens.max()\n"
+            "    def inner(payload, used):\n"
+            "        return payload[:, :used]\n"
+            "    return inner\n")})
+        m = Manifest(package="fixpkg", jit_modules=("fixpkg.dev",),
+                     hot_loops=())
+        assert jitcheck.run(tree, m) == []
+
+    def test_static_shape_slices_are_clean(self, tmp_path):
+        tree = make_pkg(tmp_path, {"dev.py": (
+            "def stage(plane, mbh):\n"
+            "    rows = mbh * 16\n"
+            "    return plane[:rows, : plane.shape[1] // 2]\n")})
+        m = Manifest(package="fixpkg", jit_modules=("fixpkg.dev",),
+                     hot_loops=())
+        assert jitcheck.run(tree, m) == []
+
+    def test_hot_loop_blocking_transfer(self, tmp_path):
+        tree = make_pkg(tmp_path, {"dev.py": (
+            "import jax\n"
+            "class E:\n"
+            "    def dispatch_wave(self, staged):\n"
+            "        return jax.device_put(staged)\n"
+            "    def stage_waves(self, frames):\n"
+            "        return jax.device_put(frames)\n")})
+        m = Manifest(package="fixpkg", jit_modules=("fixpkg.dev",),
+                     hot_loops=("fixpkg.dev:E.dispatch_wave",))
+        found = jitcheck.run(tree, m)
+        # stage_waves is an allowlisted transfer site (not declared
+        # hot); only the dispatch-path device_put is flagged
+        assert codes(found) == ["TVT-X002"]
+        assert "dispatch_wave" in found[0].message
+
+    def test_async_prefetch_is_legal_in_hot_loops(self, tmp_path):
+        tree = make_pkg(tmp_path, {"dev.py": (
+            "class E:\n"
+            "    def dispatch_wave(self, out):\n"
+            "        for arr in out:\n"
+            "            arr.copy_to_host_async()\n"
+            "        return out\n")})
+        m = Manifest(package="fixpkg", jit_modules=("fixpkg.dev",),
+                     hot_loops=("fixpkg.dev:E.dispatch_wave",))
+        assert jitcheck.run(tree, m) == []
+
+    def test_plain_variable_named_item_is_not_a_transfer(self, tmp_path):
+        # `.item()` is only a sync as an ATTRIBUTE call; an ordinary
+        # loop variable named `item` must not trip TVT-X002
+        tree = make_pkg(tmp_path, {"dev.py": (
+            "class E:\n"
+            "    def dispatch_wave(self, staged):\n"
+            "        out = []\n"
+            "        for item in staged:\n"
+            "            out.append(item)\n"
+            "        return out\n")})
+        m = Manifest(package="fixpkg", jit_modules=("fixpkg.dev",),
+                     hot_loops=("fixpkg.dev:E.dispatch_wave",))
+        assert jitcheck.run(tree, m) == []
+
+    def test_same_named_methods_get_distinct_finding_keys(self, tmp_path):
+        """GopShardEncoder.dispatch_wave vs SfeShardEncoder.
+        dispatch_wave: same bare name, different classes — two
+        findings under two waiver keys, not one swallowing the
+        other."""
+        tree = make_pkg(tmp_path, {"dev.py": (
+            "class A:\n"
+            "    def fetch(self, payload, used):\n"
+            "        return payload[:, :used.max()]\n"
+            "class B:\n"
+            "    def fetch(self, payload, used):\n"
+            "        return payload[:, :used.max()]\n")})
+        m = Manifest(package="fixpkg", jit_modules=("fixpkg.dev",),
+                     hot_loops=())
+        found = jitcheck.run(tree, m)
+        assert codes(found) == ["TVT-X001", "TVT-X001"]
+        assert len({f.key for f in found}) == 2
+
+    def test_rotted_hot_loop_declaration_is_flagged(self, tmp_path):
+        tree = make_pkg(tmp_path, {"dev.py": "x = 1\n"})
+        m = Manifest(package="fixpkg", jit_modules=("fixpkg.dev",),
+                     hot_loops=("fixpkg.dev:E.gone",))
+        found = jitcheck.run(tree, m)
+        assert codes(found) == ["TVT-X002"]
+        assert "not found" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# output modes + stale-waiver enforcement (tools/check.py)
+# ---------------------------------------------------------------------------
+
+
+class TestCheckOutputs:
+    def test_json_mode_carries_path_line_and_waiver_status(self, capsys):
+        from thinvids_tpu.tools.check import run_check
+
+        rc = run_check(json_out=True)
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert doc["open"] == []
+        assert doc["stale_waivers"] == []
+        assert doc["modules_scanned"] >= 70
+        w = doc["waived"][0]
+        assert w["waived"] is True and w["reason"]
+        assert w["code"].startswith("TVT-") and w["key"]
+        assert w["path"].endswith(".py")
+        assert isinstance(w["line"], int) and w["line"] >= 1
+
+    def test_sarif_mode_is_wellformed(self, capsys):
+        from thinvids_tpu.tools.check import run_check
+
+        rc = run_check(sarif_out=True)
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert all(r.startswith("TVT-") for r in rule_ids)
+        results = run["results"]
+        # HEAD is clean, so every result is a suppressed waiver
+        assert results and all(r.get("suppressions") for r in results)
+        for r in results:
+            assert r["ruleId"] in rule_ids
+            loc = r["locations"][0]["physicalLocation"]
+            assert loc["artifactLocation"]["uri"]
+            assert loc["region"]["startLine"] >= 1
+            assert r["partialFingerprints"]["tvtKey"]
+
+    def test_stale_waiver_fails_the_check(self, capsys, monkeypatch):
+        import thinvids_tpu.analysis as analysis
+        from thinvids_tpu.tools.check import run_check
+
+        base = analysis.default_manifest()
+        stale = dataclasses.replace(
+            base, waivers={**dict(base.waivers),
+                           "TVT-Z999:never-matches": "dead debt"})
+        monkeypatch.setattr(analysis, "default_manifest", lambda: stale)
+        rc = run_check(quiet=True)
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "stale waiver" in out
+
+    def test_precommit_hook_is_installable(self):
+        hook = os.path.join(REPO, "deploy", "pre-commit")
+        assert os.path.exists(hook)
+        assert os.access(hook, os.X_OK)
+        with open(hook, encoding="utf-8") as fh:
+            body = fh.read()
+        assert "cli check" in body or "cli.py check" in body \
+            or "thinvids_tpu.cli check" in body
+        assert "test_analysis" in body
 
 
 # ---------------------------------------------------------------------------

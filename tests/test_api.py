@@ -107,6 +107,65 @@ class TestBrowsePreviewStamp:
             data = resp.read()
         assert data[4:8] == b"ftyp"
 
+    def test_stamp_restore_does_not_resurrect_stopped_job(self, api):
+        """An operator stop landing while the stamp thread runs must
+        win: the finally-restore only rewrites a job that is STILL
+        STAMPING (stop-wins, same property as the reserve guard)."""
+        from thinvids_tpu.api.server import _restore_after_stamp
+
+        server, co, execu, tmp_path = api
+        clip = tmp_path / "movie.y4m"
+        make_clip(str(clip))
+        code, job = call(f"{server.url}/add_job", "POST",
+                         {"input_path": str(clip), "auto_start": False})
+        jid = job["id"]
+        # the stamp thread set STAMPING...
+        co.store.update(jid, lambda j: setattr(
+            j, "status", Status.STAMPING))
+        # ...the operator stops mid-stamp...
+        co.stop_job(jid)
+        assert co.store.get(jid).status is Status.STOPPED
+        # ...and the stamp thread's restore must NOT resurrect it
+        _restore_after_stamp(co, jid, Status.READY)
+        assert co.store.get(jid).status is Status.STOPPED
+        # while an undisturbed STAMPING job restores normally
+        co.store.update(jid, lambda j: setattr(
+            j, "status", Status.STAMPING))
+        _restore_after_stamp(co, jid, Status.READY)
+        assert co.store.get(jid).status is Status.READY
+
+    def test_stamp_rejected_job_refused(self, api):
+        """REJECTED absorbs (the declared job machine): the stamp flow
+        must not put an admission-rejected job back to work."""
+        server, co, execu, tmp_path = api
+        clip = tmp_path / "movie.y4m"
+        make_clip(str(clip))
+        code, job = call(f"{server.url}/add_job", "POST",
+                         {"input_path": str(clip), "auto_start": False})
+        jid = job["id"]
+        co.store.update(jid, lambda j: setattr(
+            j, "status", Status.REJECTED))
+        code, out = call(f"{server.url}/stamp_job/{jid}", "POST", {})
+        assert code == 409
+        assert co.store.get(jid).status is Status.REJECTED
+
+    def test_stamp_entry_guard_is_atomic_with_the_write(self, api):
+        """The STAMPING entry re-checks under the store lock: a job
+        that turned active (scheduler reserve) after the handler's
+        snapshot must 409 instead of taking the undeclared
+        STARTING→STAMPING edge."""
+        server, co, execu, tmp_path = api
+        clip = tmp_path / "movie.y4m"
+        make_clip(str(clip))
+        code, job = call(f"{server.url}/add_job", "POST",
+                         {"input_path": str(clip), "auto_start": False})
+        jid = job["id"]
+        co.store.update(jid, lambda j: setattr(
+            j, "status", Status.STARTING))
+        code, out = call(f"{server.url}/stamp_job/{jid}", "POST", {})
+        assert code == 409
+        assert co.store.get(jid).status is Status.STARTING
+
     def test_stamp_job_creates_stamped_copy(self, api):
         from thinvids_tpu.io.y4m import read_y4m
         from thinvids_tpu.tools.stamp import read_stamp, stamp_width_px

@@ -356,3 +356,116 @@ class TestRegistry:
         snap = coord.job_settings(coord.store.get(a.id))
         assert snap.qp == 40
         assert "bogus" not in snap.values
+
+
+class TestProtocolGuards:
+    """Regression tests for the TVT-M001 status-machine guards: every
+    Status write site in the coordinator now proves its source states
+    locally, so the races/holes below stay fixed (see the declared job
+    table in analysis/manifest.py)."""
+
+    def test_stop_on_terminal_job_is_a_noop(self):
+        coord, _ = make_coord()
+        a = coord.add_job("/in/a.y4m", meta())
+        tok = coord.store.get(a.id).run_token
+        coord.mark_running(a.id, tok)
+        assert coord.complete_job(a.id, tok, "/lib/a.mp4", 7)
+        stopped = coord.stop_job(a.id)
+        # terminal absorbs: the result must survive an operator stop
+        assert stopped.status is Status.DONE
+        assert stopped.output_path == "/lib/a.mp4"
+
+    def test_stale_watchdog_verdict_cannot_fail_done_job(self):
+        # the watchdog reads the active set as a snapshot; simulate
+        # the job completing between that read and the fail write
+        coord, _ = make_coord()
+        a = coord.add_job("/in/a.y4m", meta())
+        tok = coord.store.get(a.id).run_token
+        coord.mark_running(a.id, tok)
+        coord.complete_job(a.id, tok, "/lib/a.mp4", 7)
+        coord._fail(a.id, "encode", "w00", "no heartbeat (stale)")
+        job = coord.store.get(a.id)
+        assert job.status is Status.DONE
+        assert job.failure_reason == ""
+        assert job.output_path == "/lib/a.mp4"
+
+    def test_rejected_job_cannot_be_requeued_or_restarted(self):
+        coord, _ = make_coord(reject_av1=True)
+        a = coord.add_job("/in/clip.mkv", meta(codec="av1"))
+        assert coord.store.get(a.id).status is Status.REJECTED
+        with pytest.raises(ValueError):
+            coord.queue_job(a.id)
+        with pytest.raises(ValueError):
+            coord.restart_job(a.id)
+        assert coord.store.get(a.id).status is Status.REJECTED
+
+    def test_operator_stop_wins_reserve_race(self, monkeypatch):
+        import dataclasses as _dc
+
+        coord, _ = make_coord(auto_start_jobs=False)
+        a = coord.add_job("/in/a.y4m", meta())
+        coord.queue_job(a.id)
+        stale = [_dc.replace(j)
+                 for j in coord.store.list(Status.WAITING)]
+        coord.stop_job(a.id)
+        real_list = coord.store.list
+
+        def stale_list(status=None):
+            if status is Status.WAITING:
+                return [_dc.replace(j) for j in stale]
+            return real_list(status)
+
+        monkeypatch.setattr(coord.store, "list", stale_list)
+        # the scheduler sees the pre-stop WAITING snapshot; the
+        # reserve guard must notice the job left WAITING and bail
+        assert coord.dispatch_next_waiting_job() is None
+        assert coord.store.get(a.id).status is Status.STOPPED
+
+    def test_reserve_race_falls_through_to_next_candidate(self,
+                                                          monkeypatch):
+        import dataclasses as _dc
+
+        launched = []
+        coord, clock = make_coord(auto_start_jobs=False,
+                                  launcher=launched.append)
+        a = coord.add_job("/in/a.y4m", meta())
+        clock.advance(1)
+        b = coord.add_job("/in/b.y4m", meta())
+        coord.queue_job(a.id)
+        coord.queue_job(b.id)
+        stale = [_dc.replace(j)
+                 for j in coord.store.list(Status.WAITING)]
+        coord.stop_job(a.id)               # a raced out of WAITING
+        real_list = coord.store.list
+
+        def stale_list(status=None):
+            if status is Status.WAITING:
+                return [_dc.replace(j) for j in stale]
+            return real_list(status)
+
+        monkeypatch.setattr(coord.store, "list", stale_list)
+        job = coord.dispatch_next_waiting_job()
+        # one stopped candidate must not strand the rest of the queue
+        assert job is not None and job.id == b.id
+        assert coord.store.get(b.id).status is Status.STARTING
+        assert coord.store.get(a.id).status is Status.STOPPED
+
+    def test_straggler_mark_running_after_done_is_ignored(self):
+        coord, _ = make_coord()
+        a = coord.add_job("/in/a.y4m", meta())
+        tok = coord.store.get(a.id).run_token
+        coord.mark_running(a.id, tok)
+        coord.complete_job(a.id, tok, "/lib/a.mp4", 7)
+        coord.mark_running(a.id, tok)      # straggler executor thread
+        assert coord.store.get(a.id).status is Status.DONE
+
+    def test_second_complete_is_rejected(self):
+        coord, _ = make_coord()
+        a = coord.add_job("/in/a.y4m", meta())
+        tok = coord.store.get(a.id).run_token
+        coord.mark_running(a.id, tok)
+        assert coord.complete_job(a.id, tok, "/lib/a.mp4", 7)
+        assert not coord.complete_job(a.id, tok, "/lib/other.mp4", 9)
+        job = coord.store.get(a.id)
+        assert job.output_path == "/lib/a.mp4"
+        assert job.output_bytes == 7

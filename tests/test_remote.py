@@ -317,6 +317,49 @@ class TestShardBoard:
         _d, _t, _r, failed, _h = board.job_progress("j0")
         assert failed == "cancelled"
 
+    def test_part_from_superseded_run_is_dropped(self):
+        """Shard ids are RUN-SCOPED (the run token rides in the id), so
+        a part still in flight from a superseded run resolves to NO
+        shard in the restarted run's entry — the old run may have
+        encoded under different job settings, and its bytes must not
+        land in the new run's output. This is the TVT-M002 model's
+        `cross-run-part` invariant (mutation `shared_ids` reproduces
+        the pre-fix hole)."""
+        board, coord, _ = make_board()
+        old = make_shard(sid="j0-runAAA-0000")
+        board.add_job("j0", [old], max_attempts=3, backoff_s=0.0,
+                      quarantine_after=3, token="run-old")
+        desc = board.claim("w2")
+        assert desc["id"] == "j0-runAAA-0000"
+        # restart: fresh plan under the new token → new run-scoped ids
+        board.add_job("j0", [make_shard(sid="j0-runBBB-0000")],
+                      max_attempts=3, backoff_s=0.0,
+                      quarantine_after=3, token="run-new")
+        accepted = board.submit_part(
+            desc["id"], "w2", [fake_segment(0, 0, 2),
+                               fake_segment(1, 2, 2)])
+        assert not accepted
+        done, total, *_rest = board.job_progress("j0")
+        assert (done, total) == (0, 2)
+
+    def test_shard_ids_embed_the_run_token(self, tmp_path):
+        """RemoteExecutor._shards_for scopes every shard id to the run
+        token that planned it (restart ⇒ disjoint id namespaces)."""
+        from thinvids_tpu.cluster.jobs import Job
+
+        settings = make_settings()
+        coord, execu = make_remote_rig(tmp_path, settings)
+        job = Job(id="deadbeefdeadbeef", input_path="/in/a.y4m")
+        vm = VideoMeta(width=64, height=48, num_frames=16)
+        plan = execu._plan_remote(16, settings)
+        ids_a = [s.id for s in execu._shards_for(
+            job, vm, plan, settings, qp=30, token="aaaa1111")]
+        ids_b = [s.id for s in execu._shards_for(
+            job, vm, plan, settings, qp=30, token="bbbb2222")]
+        assert all("aaaa11" in sid for sid in ids_a)
+        assert all("bbbb22" in sid for sid in ids_b)
+        assert not set(ids_a) & set(ids_b)
+
     def test_snapshot_carries_timings(self):
         board, coord, clock = make_board()
         board.add_job("j0", [make_shard()], max_attempts=3, backoff_s=0.0,

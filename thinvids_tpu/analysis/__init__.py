@@ -1,25 +1,38 @@
 """Repo-native static analysis: machine-checked architecture invariants.
 
-Four passes over the package's ASTs, driven by the declarative
+Six passes over the package's ASTs, driven by the declarative
 manifest (analysis/manifest.py) and runnable in <5 s without jax:
 
-1. imports     — jax confinement (TVT-J001) + forbidden symbols
-                 (TVT-J002): declared jax-free modules never reach
-                 `jax` through any module-scope import chain.
-2. syncs       — host-sync confinement (TVT-S001/S002): blocking
-                 device_get / block_until_ready / implicit
-                 np.asarray-on-device syncs stay inside the dispatch
-                 boundary.
-3. threads     — thread-safety audit (TVT-T001/T002/T003): unlocked
-                 cross-entrypoint writes, blocking calls under locks,
-                 lock-order inversions.
-4. configcheck — config discipline (TVT-C001/C002/C003): no dead
-                 settings keys, a registered TVT_* env namespace, no
-                 raw settings subscripts around the clamp tier.
+1. imports      — jax confinement (TVT-J001) + forbidden symbols
+                  (TVT-J002): declared jax-free modules never reach
+                  `jax` through any module-scope import chain.
+2. syncs        — host-sync confinement (TVT-S001/S002): blocking
+                  device_get / block_until_ready / implicit
+                  np.asarray-on-device syncs stay inside the dispatch
+                  boundary.
+3. threads      — thread-safety audit (TVT-T001..T005): unlocked
+                  cross-entrypoint writes, blocking calls under locks,
+                  lock-order inversions, guarded-by/lockset
+                  violations, cross-object lock-order cycles.
+4. configcheck  — config discipline (TVT-C001/C002/C003): no dead
+                  settings keys, a registered TVT_* env namespace, no
+                  raw settings subscripts around the clamp tier.
+5. statemachine — protocol verification (TVT-M001/M002): every
+                  ShardState/Status write site in cluster/ is audited
+                  against the declared transition tables, and a
+                  bounded exhaustive explorer over a faithful
+                  ShardBoard model proves the lease protocol's safety
+                  invariants (no double-assign, first-result-wins,
+                  attempt accounting, token fencing, collect gating).
+6. jitcheck     — jit/retrace discipline (TVT-X001/X002): the jit
+                  surface stays in the declared device modules, slice
+                  bounds are shape-quantized (the PR 4 rule), and the
+                  wave/frame hot loops never block on a transfer.
 
 Run via ``python -m thinvids_tpu.cli check`` (tools/check.py); tier-1
 shells out to it (tests/test_analysis.py), replacing the per-file grep
-guards that used to live in four separate test files.
+guards that used to live in four separate test files. ``--json`` and
+``--sarif`` emit machine-readable findings for CI and editors.
 
 jax-free by contract — and self-hosted: this package is in its own
 manifest's `jax_free` list, so the analyzer analyzes itself.
@@ -35,13 +48,16 @@ def run_all(tree: SourceTree, manifest: Manifest,
             defaults: dict | None = None) -> list[Finding]:
     """Every pass over one source tree; findings in pass order
     (waivers NOT applied — see apply_waivers)."""
-    from . import configcheck, imports, syncs, threads
+    from . import (configcheck, imports, jitcheck, statemachine, syncs,
+                   threads)
 
     findings: list[Finding] = []
     findings += imports.run(tree, manifest)
     findings += syncs.run(tree, manifest)
     findings += threads.run(tree, manifest)
     findings += configcheck.run(tree, manifest, defaults)
+    findings += statemachine.run(tree, manifest)
+    findings += jitcheck.run(tree, manifest)
     return findings
 
 

@@ -145,6 +145,30 @@ def terminal_name(node: ast.AST) -> str | None:
     return None
 
 
+def qualified_functions(tree: ast.Module):
+    """(qualified name, node) for every function-like scope at any
+    depth — FunctionDef/AsyncFunctionDef (qualified through enclosing
+    classes and functions, ``Cls.method.nested``) and Lambda (as
+    ``prefix<lambda>``). Shared by the statemachine and jitcheck
+    passes so qualification rules cannot drift between them."""
+
+    def rec(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                yield q, child
+                yield from rec(child, q + ".")
+            elif isinstance(child, ast.ClassDef):
+                yield from rec(child, f"{prefix}{child.name}.")
+            elif isinstance(child, ast.Lambda):
+                yield f"{prefix}<lambda>", child
+                yield from rec(child, prefix)
+            else:
+                yield from rec(child, prefix)
+
+    yield from rec(tree, "")
+
+
 def is_type_checking_if(node: ast.AST) -> bool:
     """``if TYPE_CHECKING:`` / ``if typing.TYPE_CHECKING:`` guard —
     its imports never execute, so the import graph skips them."""

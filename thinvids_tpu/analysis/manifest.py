@@ -3,8 +3,13 @@
 This file IS the codebase's correctness contract: which modules must
 stay importable without jax, where host-device synchronization is
 allowed to live, which thread entrypoints exist beyond what the AST
-can discover, which process-level env knobs are registered, and the
-(short) waiver list for findings that are understood and accepted.
+can discover, which lock guards which field, the control-plane state
+machines as explicit transition tables (audited at every write site
+AND model-checked — analysis/statemachine.py), the jit/retrace
+discipline (where the jit surface lives, which helpers pin shapes,
+which hot loops must never block on a transfer), which process-level
+env knobs are registered, and the (short) waiver list for findings
+that are understood and accepted.
 
 It replaces the per-file grep guards that used to live inside
 tests/test_compact.py (device_get allowlist), tests/test_streaming.py
@@ -29,8 +34,123 @@ from typing import Mapping
 
 
 @dataclasses.dataclass(frozen=True)
+class StateMachine:
+    """One declared control-plane state machine.
+
+    `attr` names the instance attribute whose enum writes the TVT-M001
+    audit checks inside `scope` (every ``x.<attr> = <Enum>.<MEMBER>``
+    write site must carry a local guard proving its source states, and
+    every implied source→target edge must be in `transitions`). An
+    empty `attr` declares a machine that is model-checked only (the
+    QoS gate keeps its state implicitly)."""
+
+    name: str
+    enum: str                       # enum simple name ("ShardState")
+    attr: str                       # audited instance attribute, "" = none
+    scope: tuple[str, ...]          # module prefixes the audit scans
+    states: tuple[str, ...]
+    initial: tuple[str, ...]        # legal construction-time states
+    transitions: tuple[tuple[str, str], ...]
+    #: boolean predicate properties on the enum → the states they admit
+    #: (``shard.state.is_open`` narrows to PENDING|ASSIGNED)
+    predicates: Mapping[str, tuple[str, ...]] = dataclasses.field(
+        default_factory=dict)
+
+
+#: the ShardBoard lease machine (cluster/remote.py). PENDING→DONE is
+#: real: a late part from an expired-then-requeued lease is accepted
+#: while the shard is open (first result wins, deterministic encode).
+#: DONE and FAILED absorb. The TVT-M002 explorer must exercise EXACTLY
+#: these edges — a stale table fails the check in either direction.
+SHARD_MACHINE = StateMachine(
+    name="shard",
+    enum="ShardState",
+    attr="state",
+    scope=("thinvids_tpu.cluster",),
+    states=("PENDING", "ASSIGNED", "DONE", "FAILED"),
+    initial=("PENDING",),
+    transitions=(
+        ("PENDING", "ASSIGNED"),    # claim (lease)
+        ("ASSIGNED", "DONE"),       # submit_part
+        ("PENDING", "DONE"),        # late part after requeue (open wins)
+        ("ASSIGNED", "PENDING"),    # failure/expiry requeue, preemption
+        ("ASSIGNED", "FAILED"),     # attempt budget exhausted
+    ),
+    predicates={"is_open": ("PENDING", "ASSIGNED")},
+)
+
+#: the Job status machine (cluster/jobs.py + coordinator.py). READY is
+#: the registration state; WAITING↔ the queue; STARTING/RUNNING/
+#: STAMPING are the active set; STOPPED/FAILED/DONE re-queue through
+#: queue_job or wipe through restart_job; REJECTED absorbs (re-running
+#: an admission-rejected job must go back through policy, so neither
+#: queue nor restart may resurrect it).
+JOB_MACHINE = StateMachine(
+    name="job",
+    enum="Status",
+    attr="status",
+    scope=("thinvids_tpu.cluster",),
+    states=("READY", "WAITING", "STARTING", "RUNNING", "STAMPING",
+            "STOPPED", "FAILED", "REJECTED", "DONE"),
+    initial=("READY",),
+    transitions=(
+        ("READY", "REJECTED"),      # admission policy at registration
+        # queue_job: (re-)queue from any non-active, non-rejected state
+        ("READY", "WAITING"), ("WAITING", "WAITING"),
+        ("STOPPED", "WAITING"), ("FAILED", "WAITING"),
+        ("DONE", "WAITING"),
+        ("WAITING", "STARTING"),    # scheduler reserve (run token mint)
+        # mark_running is idempotent within a run
+        ("STARTING", "RUNNING"), ("RUNNING", "RUNNING"),
+        # completion / failure only from the active set
+        ("STARTING", "DONE"), ("RUNNING", "DONE"), ("STAMPING", "DONE"),
+        ("STARTING", "FAILED"), ("RUNNING", "FAILED"),
+        ("STAMPING", "FAILED"),
+        # operator stop: non-terminal states only (terminal absorbs)
+        ("READY", "STOPPED"), ("WAITING", "STOPPED"),
+        ("STARTING", "STOPPED"), ("RUNNING", "STOPPED"),
+        ("STAMPING", "STOPPED"),
+        # the api stamp flow (api/server.py _h_stamp_job — OUTSIDE the
+        # cluster/ audit scope, declared here so the table stays the
+        # whole protocol's spec): any non-active, non-rejected job may
+        # enter STAMPING and is restored to its prior status after
+        ("READY", "STAMPING"), ("WAITING", "STAMPING"),
+        ("STOPPED", "STAMPING"), ("FAILED", "STAMPING"),
+        ("DONE", "STAMPING"),
+        ("STAMPING", "READY"), ("STAMPING", "WAITING"),
+        ("STAMPING", "STOPPED"),
+        # restart wipe: everything except REJECTED
+        ("READY", "READY"), ("WAITING", "READY"), ("STARTING", "READY"),
+        ("RUNNING", "READY"), ("STAMPING", "READY"),
+        ("STOPPED", "READY"), ("FAILED", "READY"), ("DONE", "READY"),
+    ),
+    predicates={
+        "is_active": ("STARTING", "RUNNING", "STAMPING"),
+        "is_terminal": ("STOPPED", "FAILED", "REJECTED", "DONE"),
+    },
+)
+
+#: the QoS batch gate (cluster/qos.py): OPEN admits batch claims,
+#: PREEMPTING withholds them. No AST-audited attribute (the controller
+#: keeps the state as an Event + breached set); the TVT-M002 board
+#: model drives breach/recover and validates against this table.
+QOS_GATE_MACHINE = StateMachine(
+    name="qos-gate",
+    enum="",
+    attr="",
+    scope=(),
+    states=("OPEN", "PREEMPTING"),
+    initial=("OPEN",),
+    transitions=(
+        ("OPEN", "PREEMPTING"),     # live part deadline breach
+        ("PREEMPTING", "OPEN"),     # recovery / live job terminal
+    ),
+)
+
+
+@dataclasses.dataclass(frozen=True)
 class Manifest:
-    """Declarative inputs to the four analysis passes. Defaults are
+    """Declarative inputs to the analysis passes. Defaults are
     the thinvids_tpu contract; tests build custom instances around
     fixture packages."""
 
@@ -131,6 +251,68 @@ class Manifest:
         "time.sleep", "sleep", "urlopen", "subprocess.run",
         "subprocess.check_call", "subprocess.check_output",
         "subprocess.Popen",
+    )
+
+    # -- pass 3b: guarded-by inference (TVT-T004) ---------------------
+    #: "module:Class.attr" → lock attribute: the field is part of the
+    #: class's lock-protected state, so EVERY read/write site outside
+    #: __init__ must hold that lock (lexical `with self.<lock>:` or the
+    #: *_locked caller-holds convention). Beyond this declared set the
+    #: pass still infers: a field written under two DIFFERENT locks
+    #: (empty lockset intersection) from multi-threaded code is a
+    #: finding without any declaration.
+    guarded_by: Mapping[str, str] = dataclasses.field(
+        default_factory=lambda: {
+            "thinvids_tpu.cluster.remote:ShardBoard._jobs": "_lock",
+            "thinvids_tpu.cluster.remote:ShardBoard._order": "_lock",
+            "thinvids_tpu.cluster.jobs:JobStore._jobs": "_lock",
+            "thinvids_tpu.cluster.coordinator:WorkerRegistry._workers":
+                "_lock",
+            "thinvids_tpu.cluster.coordinator:Coordinator._active_ids":
+                "_sched_lock",
+            "thinvids_tpu.cluster.qos:QosController._breached": "_lock",
+        })
+
+    # -- pass 5: protocol state machines (TVT-M001/M002) --------------
+    #: declared control-plane machines: transition tables the AST audit
+    #: checks write sites against, and the bounded explorer validates
+    #: the board model against (see analysis/statemachine.py).
+    state_machines: tuple[StateMachine, ...] = (
+        SHARD_MACHINE, JOB_MACHINE, QOS_GATE_MACHINE)
+
+    # -- pass 6: jit/retrace discipline (TVT-X001/X002) ---------------
+    #: modules allowed to DEFINE `jax.jit` entry points — the repo's
+    #: whole jit surface lives here, so a stray jit elsewhere (which
+    #: would grow its own retrace cache outside the pinned-shape
+    #: regime) is a finding.
+    jit_modules: tuple[str, ...] = (
+        "thinvids_tpu.parallel.dispatch",
+        "thinvids_tpu.parallel.rc",
+        "thinvids_tpu.abr.scale",
+        "thinvids_tpu.codecs.h264.jaxcore",
+        "thinvids_tpu.codecs.h264.jaxme",
+        "thinvids_tpu.codecs.h264.jaxinter",
+    )
+    #: helper names whose RESULT is a pinned/quantized shape bound: a
+    #: data-dependent slice bound (anything derived from `.max()` /
+    #: `.item()` on runtime data) inside a jit module must route
+    #: through one of these, or every wave recompiles (the PR 4
+    #: quantized-slice rule; `cut` is the used-prefix quantizer in
+    #: GopShardEncoder._fetch_payload_rows).
+    shape_quantizers: tuple[str, ...] = ("cut",)
+    #: wave/frame hot-loop functions ("module:Qual.name"): code that
+    #: runs once per dispatched wave or per SFE frame step. Blocking
+    #: transfers (`device_put`, `device_get`, `block_until_ready`,
+    #: `.item()`) are banned here — staging (stage_waves) and collect
+    #: (collect_wave, _fetch_*) are the allowlisted transfer sites and
+    #: are deliberately NOT in this set.
+    hot_loops: tuple[str, ...] = (
+        "thinvids_tpu.parallel.dispatch:GopShardEncoder.dispatch_wave",
+        "thinvids_tpu.parallel.dispatch:GopShardEncoder.encode_waves",
+        "thinvids_tpu.parallel.dispatch:SfeShardEncoder.dispatch_wave",
+        "thinvids_tpu.parallel.dispatch:SfeShardEncoder.encode_waves",
+        "thinvids_tpu.parallel.dispatch:SfeShardEncoder._intra_step",
+        "thinvids_tpu.parallel.dispatch:SfeShardEncoder._p_step",
     )
 
     # -- pass 4: config discipline (TVT-C001/C002/C003) ---------------

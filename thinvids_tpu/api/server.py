@@ -79,6 +79,22 @@ _SORTABLE = {f.name for f in dataclasses.fields(Job)
              if str(f.type) in ("str", "int", "float")} | {"status"}
 
 
+def _restore_after_stamp(co, job_id: str, prior_status: Status) -> None:
+    """Put a stamped job's status back — ONLY if it is still STAMPING.
+    An operator stop (or delete) landing while the stamp thread runs
+    must win: restoring unconditionally would resurrect a STOPPED job
+    into the scheduler (the same stop-wins property the coordinator's
+    reserve guard enforces). Declared in the job machine's table as
+    STAMPING→{prior} (analysis/manifest.py)."""
+    def apply(j: Job) -> None:
+        if j.status is Status.STAMPING:
+            j.status = prior_status
+    try:
+        co.store.update(job_id, apply)
+    except KeyError:
+        pass                    # job deleted mid-stamp: nothing to do
+
+
 class _FileResponse:
     """Handler payload sentinel: serve a file instead of JSON (the
     reference's send_file preview, manager/app.py:2402-2460).
@@ -792,12 +808,29 @@ class ApiServer:
         DONE job must not erase its terminal state). Runs inline for
         y4m-sized sources; pass {"sync": false} to spawn a thread."""
         job = self._get_job(job_id)
-        if job.status.is_active:
-            raise ApiError(409, f"job is {job.status.value}; stop it first")
         co = self.coordinator
-        prior_status = job.status
-        co.store.update(job_id, lambda j: setattr(j, "status",
-                                                  Status.STAMPING))
+        prior: list[Status] = []
+
+        def enter_stamping(j: Job) -> None:
+            # guard + prior capture + write in ONE store.update: a
+            # scheduler reserve or operator stop racing the outside-
+            # the-lock check must win (otherwise this write performs
+            # an undeclared STARTING/STOPPED→STAMPING edge and the
+            # restore later resurrects a stopped job)
+            if j.status.is_active:
+                raise ApiError(
+                    409, f"job is {j.status.value}; stop it first")
+            if j.status is Status.REJECTED:
+                # REJECTED absorbs (the declared job machine in
+                # analysis/manifest.py): an admission-rejected job
+                # must be re-added, not put back to work
+                raise ApiError(409,
+                               "job was rejected by admission policy")
+            prior.append(j.status)
+            j.status = Status.STAMPING
+
+        co.store.update(job_id, enter_stamping)
+        prior_status = prior[0]
 
         def work() -> None:
             from ..ingest.decode import open_video
@@ -847,8 +880,7 @@ class ApiServer:
                 co.activity.emit("error", f"stamp failed: {exc}",
                                  job_id=job_id)
             finally:
-                co.store.update(job_id, lambda j: setattr(
-                    j, "status", prior_status))
+                _restore_after_stamp(co, job_id, prior_status)
 
         if body.get("sync", True):
             work()

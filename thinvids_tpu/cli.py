@@ -244,13 +244,15 @@ def run_trace(args: argparse.Namespace) -> None:
 
 def run_check(args: argparse.Namespace) -> None:
     """Static analysis over this repo (tools/check.py): jax/sync
-    confinement, thread-safety audit, config discipline. jax-free and
-    fast — tier-1 shells out to it. Delegates to tools.check.main so
-    the documented exit codes (0 clean / 1 findings / 2 internal
-    error) hold from this entry point too."""
+    confinement, thread-safety audit, config discipline, the
+    control-plane protocol model check, and jit discipline. jax-free
+    and fast — tier-1 shells out to it. Delegates to tools.check.main
+    so the documented exit codes (0 clean / 1 findings or stale
+    waivers / 2 internal error) hold from this entry point too."""
     from .tools.check import main as check_main
 
     argv = (["--json"] if args.json else []) \
+        + (["--sarif"] if getattr(args, "sarif", False) else []) \
         + (["--quiet"] if args.quiet else [])
     raise SystemExit(check_main(argv))
 
@@ -338,9 +340,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     k = sub.add_parser("check", help="static analysis: jax/sync "
                                      "confinement, thread safety, "
-                                     "config discipline")
+                                     "config discipline, protocol "
+                                     "model check, jit discipline")
     k.add_argument("--json", action="store_true",
                    help="machine-readable findings")
+    k.add_argument("--sarif", action="store_true",
+                   help="SARIF 2.1.0 findings for CI/editors")
     k.add_argument("--quiet", action="store_true",
                    help="suppress the clean-run summary")
     k.set_defaults(fn=run_check)

@@ -213,9 +213,16 @@ class Coordinator:
         job = self.store.create(input_path, meta=meta, settings=settings,
                                 job_type=job_type)
         if not decision.accepted:
-            job = self.store.update(job.id, lambda j: (
-                setattr(j, "status", Status.REJECTED),
-                setattr(j, "reject_reason", decision.reason)))
+            def reject(j: Job) -> None:
+                # freshly created above, so READY is the only possible
+                # source — asserted so the READY→REJECTED edge is
+                # locally provable (TVT-M001)
+                if j.status is not Status.READY:
+                    raise ValueError(
+                        f"job {j.id} is {j.status.value}, not READY")
+                j.status = Status.REJECTED
+                j.reject_reason = decision.reason
+            job = self.store.update(job.id, reject)
             self.activity.emit("reject", f"rejected: {decision.reason}",
                                job_id=job.id)
             return job
@@ -236,6 +243,12 @@ class Coordinator:
         def apply(j: Job) -> None:
             if j.status.is_active:
                 raise ValueError(f"job {j.id} is {j.status.value}")
+            if j.status is Status.REJECTED:
+                # admission said no; re-queueing would bypass policy —
+                # a rejected job must be re-added to be re-evaluated
+                raise ValueError(
+                    f"job {j.id} was rejected by admission policy; "
+                    f"re-add it to re-evaluate")
             j.status = Status.WAITING
             j.queued_at = now
         job = self.store.update(job_id, apply)
@@ -243,10 +256,19 @@ class Coordinator:
         return job
 
     def stop_job(self, job_id: str) -> Job:
+        changed: list[bool] = []
+
         def apply(j: Job) -> None:
+            if j.status.is_terminal:
+                # terminal absorbs: stopping a DONE/FAILED/REJECTED job
+                # must not erase its result or failure attribution
+                return
             j.status = Status.STOPPED
             j.run_token = ""            # fences out in-flight executors
+            changed.append(True)
         job = self.store.update(job_id, apply)
+        if not changed:
+            return job
         with self._sched_lock:
             self._active_ids.discard(job_id)
         self.qos.clear_live(job_id)
@@ -257,6 +279,12 @@ class Coordinator:
         """Wipe run state and requeue (the reference's /restart_job,
         /root/reference/manager/app.py:2501-2666)."""
         def apply(j: Job) -> None:
+            if j.status is Status.REJECTED:
+                # restart re-runs the pipeline, not admission — a
+                # rejected job must be re-added to be re-evaluated
+                raise ValueError(
+                    f"job {j.id} was rejected by admission policy; "
+                    f"re-add it to re-evaluate")
             j.run_token = ""
             j.segment_progress = 0.0
             j.encode_progress = 0.0
@@ -367,6 +395,11 @@ class Coordinator:
             return False
 
         def apply(j: Job) -> None:
+            # token-fenced already; the status guard makes the edge
+            # locally provable (idempotent within a run — a second
+            # mark_running while RUNNING is a no-op write)
+            if j.status not in (Status.STARTING, Status.RUNNING):
+                return
             j.status = Status.RUNNING
         self.store.update(job_id, apply)
         return True
@@ -426,15 +459,24 @@ class Coordinator:
         if not self.token_is_current(job_id, token):
             return False
         now = self._clock()
+        changed: list[bool] = []
 
         def apply(j: Job) -> None:
+            if not j.status.is_active:
+                # the run's token is still current but the job already
+                # left the active set — completion must not resurrect
+                # a non-active job
+                return
             j.status = Status.DONE
             j.finished_at = now
             j.elapsed_s = now - j.started_at if j.started_at else 0.0
             j.output_path = output_path
             j.output_bytes = output_bytes
             j.combine_progress = 100.0
+            changed.append(True)
         self.store.update(job_id, apply)
+        if not changed:
+            return False
         with self._sched_lock:
             self._active_ids.discard(job_id)
         self.qos.clear_live(job_id)
@@ -453,15 +495,25 @@ class Coordinator:
 
     def _fail(self, job_id: str, stage: str, host: str, reason: str) -> None:
         now = self._clock()
+        changed: list[bool] = []
 
         def apply(j: Job) -> None:
+            if not j.status.is_active:
+                # the watchdog reads the active set as a snapshot: a
+                # job that completes (or is stopped) between that read
+                # and this write must keep its terminal state — a
+                # stale stall verdict must not flip DONE to FAILED
+                return
             j.status = Status.FAILED
             j.finished_at = now
             j.run_token = ""            # revoke: fence out stragglers
             j.failure_stage = stage
             j.failure_host = host
             j.failure_reason = reason
+            changed.append(True)
         self.store.update(job_id, apply)
+        if not changed:
+            return
         with self._sched_lock:
             self._active_ids.discard(job_id)
         self.qos.clear_live(job_id)
@@ -570,23 +622,40 @@ class Coordinator:
         with self._sched_lock:
             active = self._active_jobs_locked()
             waiting = self.store.list(Status.WAITING)
-            if not waiting:
-                return None
-            chosen = min(waiting, key=lambda j: (
-                self._job_rank(j, snap), j.queued_at or j.created_at))
-            ok, _why = self._can_dispatch_locked(
-                active, snap, now, rank=self._job_rank(chosen, snap))
-            if not ok:
-                return None
-            token = new_run_token()
+            job = None
+            while waiting:
+                chosen = min(waiting, key=lambda j: (
+                    self._job_rank(j, snap), j.queued_at or j.created_at))
+                ok, _why = self._can_dispatch_locked(
+                    active, snap, now, rank=self._job_rank(chosen, snap))
+                if not ok:
+                    return None
+                token = new_run_token()
 
-            def reserve(j: Job) -> None:
-                j.status = Status.STARTING
-                j.run_token = token
-                j.started_at = now
-                j.heartbeat_at = now
-                j.heartbeat_stage = "reserve"
-            job = self.store.update(chosen.id, reserve)
+                def reserve(j: Job) -> None:
+                    if j.status is not Status.WAITING:
+                        # `waiting` is a snapshot: an operator stop
+                        # landing between the list() and this write
+                        # must win — a stopped job must not be revived
+                        # into STARTING
+                        raise ValueError(
+                            f"job {j.id} left WAITING before reserve "
+                            f"({j.status.value})")
+                    j.status = Status.STARTING
+                    j.run_token = token
+                    j.started_at = now
+                    j.heartbeat_at = now
+                    j.heartbeat_stage = "reserve"
+                try:
+                    job = self.store.update(chosen.id, reserve)
+                except (ValueError, KeyError):
+                    # the chosen job raced out of WAITING (stopped or
+                    # deleted): drop it and consider the next candidate
+                    waiting = [j for j in waiting if j.id != chosen.id]
+                    continue
+                break
+            if job is None:
+                return None
             self._active_ids.add(job.id)
         # fresh distributed trace per dispatch (a restart must not
         # interleave spans with the old run); sampling decided here

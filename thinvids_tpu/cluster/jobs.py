@@ -148,8 +148,13 @@ class JobStore:
         if path:
             self._acquire_lockfile()
             try:
-                self._replay()
-                self._compact_locked()
+                # construction is single-threaded, but `_jobs` is
+                # lock-guarded state (TVT-T004): hold the lock so the
+                # replay/compact sites follow the same discipline as
+                # every other access
+                with self._lock:
+                    self._replay_locked()
+                    self._compact_locked()
             except BaseException:
                 self.close()           # don't leak the flock on failure
                 raise
@@ -190,7 +195,7 @@ class JobStore:
 
     # -- journal -------------------------------------------------------
 
-    def _replay(self) -> None:
+    def _replay_locked(self) -> None:
         if not os.path.exists(self._path):
             return
         with open(self._path, encoding="utf-8") as fh:

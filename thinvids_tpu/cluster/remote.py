@@ -43,7 +43,7 @@ import socket
 import struct
 import threading
 import time
-from typing import Any, Callable, Iterable, Mapping
+from typing import TYPE_CHECKING, Any, Callable, Iterable, Mapping
 
 from ..core.status import ShardState
 from ..core.types import (ChromaFormat, EncodedSegment, GopSpec, SegmentPlan,
@@ -53,6 +53,9 @@ from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from .executor import HaltedError, LocalExecutor
 from .jobs import Job
+
+if TYPE_CHECKING:
+    from .coordinator import Coordinator
 
 # ---------------------------------------------------------------------------
 # wire helpers
@@ -227,7 +230,7 @@ class ShardBoard:
     the oldest eligible PENDING shard across jobs (FIFO keeps the drain
     scheduler's admission assumptions intact)."""
 
-    def __init__(self, coordinator,
+    def __init__(self, coordinator: "Coordinator",
                  clock: Callable[[], float] = time.time) -> None:
         self.coordinator = coordinator
         self._clock = clock
@@ -388,7 +391,11 @@ class ShardBoard:
                 key = (shard.priority, pos)
                 if best_key is None or key < best_key:
                     best, best_key = shard, key
-            if best is not None:
+            if best is not None and best.state is ShardState.PENDING:
+                # the re-assert is free under the lock and makes the
+                # lease edge locally provable: only PENDING→ASSIGNED
+                # exists (TVT-M001 audits this site against the
+                # declared shard table)
                 best.state = ShardState.ASSIGNED
                 best.assigned_host = host
                 best.assigned_at = now
@@ -660,11 +667,20 @@ class RemoteExecutor(LocalExecutor):
                              plan_devices, int(settings.max_segments))
 
     def _shards_for(self, job: Job, meta, plan: SegmentPlan, settings,
-                    qp: int, rung=None) -> list[Shard]:
+                    qp: int, rung=None, token: str = "") -> list[Shard]:
         """Cut one GOP plan into leased shards. With `rung` set
         (abr.ladder.Rung) the shards are tagged for that rendition —
         same GOP ranges as every other rung, so the rendition set stays
-        boundary-aligned no matter which workers encode which rungs."""
+        boundary-aligned no matter which workers encode which rungs.
+
+        Shard ids are RUN-SCOPED (the run token rides in the id): a
+        restarted job plans fresh shards under a new token, so a part
+        still in flight from the superseded run resolves to NO shard
+        and is dropped instead of landing in the new run's entry — the
+        old run may have encoded under different job settings (QP,
+        gop_frames), so a same-id part would be silently wrong bytes.
+        The TVT-M002 board model checks exactly this (`cross-run-part`
+        invariant; the `shared_ids` mutation reproduces the hole)."""
         from .qos import job_rank
 
         workers = self._live_workers()
@@ -680,10 +696,11 @@ class RemoteExecutor(LocalExecutor):
             getattr(job, "job_type", "transcode"),
             str(settings.get("job_priority", "auto") or "auto"))
         trace_id = obs_trace.TRACE.trace_id(job.id)
+        run = f"{token[:6]}-" if token else ""
         for i in range(0, plan.num_gops, per_shard):
             gops = plan.gops[i:i + per_shard]
             shards.append(Shard(
-                id=f"{job.id[:12]}-{tag}{gops[0].index:04d}",
+                id=f"{job.id[:12]}-{run}{tag}{gops[0].index:04d}",
                 job_id=job.id, input_path=job.input_path, meta=meta,
                 gops=tuple(gops), qp=int(qp),
                 gop_frames=int(settings.gop_frames),
@@ -699,10 +716,11 @@ class RemoteExecutor(LocalExecutor):
         return shards
 
     def _build_shards(self, job: Job, meta, num_frames: int,
-                      settings) -> tuple[SegmentPlan, list[Shard]]:
+                      settings, token: str = ""
+                      ) -> tuple[SegmentPlan, list[Shard]]:
         plan = self._plan_remote(num_frames, settings)
         return plan, self._shards_for(job, meta, plan, settings,
-                                      qp=int(settings.qp))
+                                      qp=int(settings.qp), token=token)
 
     # -- encode stage override -----------------------------------------
 
@@ -774,7 +792,8 @@ class RemoteExecutor(LocalExecutor):
 
         stage[0] = "segment"
         self._await_first_workers(job, token, settings)
-        plan, shards = self._build_shards(job, meta, len(frames), settings)
+        plan, shards = self._build_shards(job, meta, len(frames),
+                                          settings, token=token)
         co.update_progress(job.id, token, parts_total=plan.num_gops,
                            segment_progress=100.0)
         co.heartbeat_job(
@@ -868,7 +887,8 @@ class RemoteExecutor(LocalExecutor):
         shards: list[Shard] = []
         for rung in rungs:
             shards.extend(self._shards_for(job, meta, plan, settings,
-                                           qp=rung.qp, rung=rung))
+                                           qp=rung.qp, rung=rung,
+                                           token=token))
         total_parts = plan.num_gops * len(rungs)
         co.update_progress(job.id, token, parts_total=total_parts,
                            segment_progress=100.0)
