@@ -743,13 +743,176 @@ def _run_origin(w: int, h: int, nframes: int, qp: int, gop_frames: int,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _run_autoscale(w: int, h: int, nframes: int, qp: int,
+                   gop_frames: int, *, duration_s: float = 30.0,
+                   hi_rps: float = 0.25, farm_max: int = 3,
+                   kill_interval_s: float | None = None,
+                   partition_s: float | None = None) -> dict:
+    """Elastic-farm figures under chaos, through the PRODUCTION stack:
+    a real coordinator + RemoteExecutor + HTTP API, a CapacityController
+    with ``autoscale_enabled`` scaling REAL ``cli.py worker``
+    subprocesses (farm.SubprocessProvider) between 0 and `farm_max`,
+    and the loadgen chaos harness driving a diurnal job-submission
+    curve while SIGKILLing workers and partitioning the /work routes.
+
+    Reported: ``autoscale_p99_queue_s`` (p99 of each job's
+    queued→dispatched wait — the price of scale-to-zero, since a job
+    arriving at a dark farm waits for a wake), ``farm_active_worker_s``
+    (the controller's integral of non-SUSPENDED worker-seconds) vs the
+    always-on figure ``farm_max × wall-clock`` — the bench RAISES
+    unless the farm measurably breathed below always-on at the trough —
+    plus jobs completed and chaos-event counts. Every job must reach
+    DONE with output bytes identical across the whole chaotic run (the
+    same clip submitted N times under two tenants with weighted
+    shares; kills and partitions may retry shards anywhere, and the
+    deterministic encode means any divergence is a real bug).
+    Submissions alternate tenants (acme:3, bravo:1) so the fair-share
+    admission layer runs under fire too."""
+    import os
+    import shutil
+    import tempfile
+    import time as _time
+
+    from thinvids_tpu.api.server import ApiServer
+    from thinvids_tpu.cluster import Coordinator
+    from thinvids_tpu.cluster.remote import RemoteExecutor
+    from thinvids_tpu.core.config import DEFAULT_SETTINGS, Settings
+    from thinvids_tpu.core.status import Status
+    from thinvids_tpu.core.types import VideoMeta
+    from thinvids_tpu.farm import CapacityController, SubprocessProvider
+    from thinvids_tpu.io.y4m import write_y4m
+    from thinvids_tpu.tools import loadgen
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    chaos_knobs = loadgen.chaos_defaults(
+        Settings(values=dict(DEFAULT_SETTINGS)))
+    if kill_interval_s is None:
+        kill_interval_s = chaos_knobs["kill_interval_s"] \
+            or duration_s / 3.0
+    if partition_s is None:
+        partition_s = chaos_knobs["partition_s"] or 3.0
+
+    meta = VideoMeta(width=w, height=h, fps_num=30, fps_den=1,
+                     num_frames=nframes)
+    snap = Settings(values=dict(
+        DEFAULT_SETTINGS, qp=qp, gop_frames=gop_frames,
+        heartbeat_throttle_s=0.0, execution_backend="remote",
+        autoscale_enabled=True, farm_min_workers=0,
+        farm_max_workers=farm_max, drain_grace_s=5.0,
+        tenant_shares="acme:3,bravo:1",
+        pipeline_worker_count=max(1, farm_max), min_idle_workers=0,
+        max_active_jobs=2, scheduler_poll_s=0.25,
+        metrics_ttl_s=5.0, remote_plan_devices=4, remote_shard_gops=1,
+        remote_shard_timeout_s=15.0, remote_retry_backoff_s=0.2,
+        remote_no_worker_grace_s=120.0))
+    tmp = tempfile.mkdtemp(prefix="tvt-autoscale-")
+    coord = Coordinator(settings_fn=lambda: snap)
+    execu = RemoteExecutor(coord, output_dir=os.path.join(tmp, "lib"),
+                           sync=False, poll_s=0.1)
+    coord._launcher = execu.launch
+    api = ApiServer(coord, work=execu.board).start()
+    provider = SubprocessProvider(
+        api.url,
+        env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo,
+                 TVT_QP=str(qp), TVT_GOP_FRAMES=str(gop_frames)))
+    farm = CapacityController(coord, provider=provider,
+                              board=execu.board)
+    coord.farm = farm
+    farm.start(poll_s=0.5)
+    coord.start_background()
+    clip = os.path.join(tmp, "chaos-src.y4m")
+    write_y4m(clip, meta, make_frames(nframes, w, h))
+    job_ids: list[str] = []
+
+    def submit(i: int) -> None:
+        tenant = "acme" if i % 2 == 0 else "bravo"
+        path = os.path.join(tmp, f"{tenant}__clip{i:04d}.y4m")
+        shutil.copyfile(clip, path)
+        job_ids.append(coord.add_job(path, meta).id)
+
+    def kill() -> bool:
+        victims = provider.hosts()
+        if not victims:
+            return False
+        return provider.kill(sorted(victims)[0])
+
+    t0 = _time.monotonic()
+    try:
+        chaos = loadgen.run_chaos_load(
+            submit, duration_s, period_s=duration_s, lo_rps=0.0,
+            hi_rps=hi_rps, kill=kill, kill_interval_s=kill_interval_s,
+            partition=api.partition_work, partition_s=partition_s)
+        if not job_ids:
+            submit(0)       # a degenerate curve must still prove a job
+        deadline = _time.monotonic() + 300.0
+        while True:
+            jobs = [coord.store.get(j) for j in job_ids]
+            if all(j.status in (Status.DONE, Status.FAILED,
+                                Status.REJECTED) for j in jobs):
+                break
+            if _time.monotonic() > deadline:
+                raise RuntimeError(
+                    "autoscale bench: jobs never drained: " + ", ".join(
+                        f"{j.id[:8]}={j.status.value}" for j in jobs))
+            _time.sleep(0.25)
+        bad = [j for j in jobs if j.status is not Status.DONE]
+        if bad:
+            raise RuntimeError(
+                "autoscale bench: job(s) did not reach DONE under "
+                "chaos: " + "; ".join(
+                    f"{j.id[:8]} {j.status.value}: {j.failure_reason}"
+                    for j in bad))
+        outputs = set()
+        for j in jobs:
+            with open(j.output_path, "rb") as fp:
+                outputs.add(fp.read())
+        if len(outputs) != 1:
+            raise RuntimeError(
+                f"autoscale bench: {len(outputs)} distinct output "
+                f"byte streams for the same clip — the chaotic farm "
+                f"broke encode determinism")
+        # let the controller observe the empty queue and breathe down
+        settle = _time.monotonic() + 3.0
+        while _time.monotonic() < settle:
+            _time.sleep(0.25)
+        elapsed = _time.monotonic() - t0
+        active_s = farm.active_worker_seconds()
+        alwayson_s = farm_max * elapsed
+        if active_s >= alwayson_s:
+            raise RuntimeError(
+                f"autoscale bench: farm never breathed — "
+                f"{active_s:.1f} active worker-seconds vs "
+                f"{alwayson_s:.1f} always-on")
+        waits = sorted(max(0.0, j.started_at - j.queued_at)
+                       for j in jobs)
+        p99 = waits[min(len(waits) - 1, int(0.99 * len(waits)))]
+        return {
+            "p99_queue_s": round(p99, 3),
+            "active_worker_s": round(active_s, 1),
+            "alwayson_worker_s": round(alwayson_s, 1),
+            "jobs_done": len(jobs),
+            "peak_workers": farm_max,
+            "kills": chaos["kills"],
+            "partitions": chaos["partitions"],
+            "duration_s": round(elapsed, 1),
+        }
+    finally:
+        coord.stop_background()
+        farm.stop()
+        provider.stop_all()
+        api.stop()
+        execu.join(30)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def build_result(r1080: dict, r4k: dict, *, platform: str, qp: int,
                  gop: int, n_1080: int, cold: dict | None = None,
                  ladder: dict | None = None,
                  live: dict | None = None,
                  origin: dict | None = None,
                  sfe: dict | None = None,
-                 trace: dict | None = None) -> dict:
+                 trace: dict | None = None,
+                 autoscale: dict | None = None) -> dict:
     """Assemble the one-line BENCH JSON from the two resolutions' runs
     (kept separate from main() so tests can assert the schema — e.g.
     the `stage_ms` breakdown and the `fps_cold_1080p` cold figure — on
@@ -829,6 +992,19 @@ def build_result(r1080: dict, r4k: dict, *, platform: str, qp: int,
         out["origin_requests"] = origin["requests"]
         out["live_latency_under_load_s"] = \
             origin["live_latency_under_load_s"]
+    if autoscale is not None:
+        # elastic farm under chaos (real worker subprocesses scaled by
+        # the capacity controller while the loadgen chaos harness
+        # kills workers and partitions /work): p99 queued→dispatched
+        # wait, and worker-seconds consumed vs. the always-on farm —
+        # the measurement inside raises unless every job reached DONE
+        # byte-identical AND the farm breathed below always-on
+        out["autoscale_p99_queue_s"] = autoscale["p99_queue_s"]
+        out["farm_active_worker_s"] = autoscale["active_worker_s"]
+        out["farm_alwayson_worker_s"] = autoscale["alwayson_worker_s"]
+        out["autoscale_jobs_done"] = autoscale["jobs_done"]
+        out["chaos_worker_kills"] = autoscale["kills"]
+        out["chaos_partitions"] = autoscale["partitions"]
     return out
 
 
@@ -868,6 +1044,13 @@ def main() -> None:
     # encoder.
     r_origin = _run_origin(320, 180, 48, qp, gop)
 
+    # Elastic farm under chaos: the capacity controller scales real
+    # worker subprocesses (CPU devices — tiny frames, the measured
+    # quantity is the CONTROL PLANE) against a diurnal submission
+    # curve with worker kills and a /work partition; raises unless
+    # every job lands DONE byte-identical and the farm breathes.
+    r_autoscale = _run_autoscale(64, 48, 16, qp, 2)
+
     # 4K rides with quality ON (psnr_y_2160p/ssim_y_2160p): 16 frames
     # keeps the untimed oracle decode affordable.
     n_4k = 16
@@ -882,7 +1065,8 @@ def main() -> None:
                                   gop=gop, n_1080=n_1080, cold=r_cold,
                                   ladder=r_ladder, live=r_live,
                                   origin=r_origin, sfe=r_sfe,
-                                  trace=r_trace)))
+                                  trace=r_trace,
+                                  autoscale=r_autoscale)))
 
 
 if __name__ == "__main__":

@@ -150,3 +150,93 @@ class TestIdleGate:
             clock["t"] = t
             agent.tick()
         assert susp == []
+
+
+class TestResume:
+    """The suspend seam's inverse: a suspended episode can now end
+    cleanly — resume_action fires once when work returns, when
+    suspend_enabled is toggled off mid-episode, or when resume() is
+    called explicitly (the capacity controller's wake path)."""
+
+    def _agent(self, clock, idle, susp, res):
+        update_live_settings({"suspend_enabled": True,
+                              "suspend_idle_s": 300.0,
+                              "suspend_cpu_pct": 200.0})
+        return NodeAgent(lambda h, m: None, host="n4",
+                         settings_fn=get_settings,
+                         idle_probe=lambda: idle["v"],
+                         suspend_action=lambda: susp.append(1),
+                         resume_action=lambda: res.append(1),
+                         clock=lambda: clock["t"])
+
+    def _suspend(self, clock, agent):
+        clock["t"] = 0.0
+        agent.tick()
+        clock["t"] = 301.0
+        agent.tick()
+
+    def test_resume_fires_when_work_returns(self):
+        clock, idle, susp, res = {"t": 0.0}, {"v": True}, [], []
+        agent = self._agent(clock, idle, susp, res)
+        self._suspend(clock, agent)
+        assert susp == [1] and res == []
+        idle["v"] = False                 # work arrived
+        clock["t"] = 400.0
+        agent.tick()
+        assert res == [1]
+        agent.tick()                      # once per episode
+        assert res == [1]
+
+    def test_toggle_off_mid_episode_resumes_and_rearms(self):
+        """Regression for the re-arm hole: disabling suspend_enabled
+        while suspended must end the episode (resume fires) AND leave
+        the gate armed for a fresh idle window when re-enabled."""
+        clock, idle, susp, res = {"t": 0.0}, {"v": True}, [], []
+        agent = self._agent(clock, idle, susp, res)
+        self._suspend(clock, agent)
+        update_live_settings({"suspend_enabled": False})
+        clock["t"] = 350.0
+        agent.tick()
+        assert res == [1]                 # episode ended cleanly
+        update_live_settings({"suspend_enabled": True})
+        clock["t"] = 400.0
+        agent.tick()                      # fresh window starts HERE
+        clock["t"] = 699.0
+        agent.tick()
+        assert susp == [1]                # 299 s idle: not yet
+        clock["t"] = 701.0
+        agent.tick()
+        assert susp == [1, 1]             # re-armed window elapsed
+
+    def test_explicit_resume_and_episode_state(self):
+        clock, idle, susp, res = {"t": 0.0}, {"v": True}, [], []
+        agent = self._agent(clock, idle, susp, res)
+        assert agent.episode_state() == {"suspended": False,
+                                         "idle_since": None}
+        assert agent.resume() is False    # nothing suspended: no-op
+        self._suspend(clock, agent)
+        assert agent.episode_state()["suspended"] is True
+        assert agent.resume() is True
+        assert res == [1]
+        assert agent.episode_state()["suspended"] is False
+        assert agent.resume() is False    # once per episode
+
+    def test_resume_without_action_is_silent(self):
+        clock, idle, susp = {"t": 0.0}, {"v": True}, []
+        update_live_settings({"suspend_enabled": True,
+                              "suspend_idle_s": 300.0,
+                              "suspend_cpu_pct": 200.0})
+        agent = NodeAgent(lambda h, m: None, host="n5",
+                          settings_fn=get_settings,
+                          idle_probe=lambda: idle["v"],
+                          suspend_action=lambda: susp.append(1),
+                          clock=lambda: clock["t"])
+        clock["t"] = 0.0
+        agent.tick()
+        clock["t"] = 301.0
+        agent.tick()
+        assert susp == [1]
+        idle["v"] = False
+        clock["t"] = 400.0
+        agent.tick()                      # no resume_action: no crash
+        assert agent.episode_state()["suspended"] is False

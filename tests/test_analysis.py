@@ -710,6 +710,34 @@ class TestBoardModel:
         assert codes(found) == ["TVT-M002"]
         assert "stale" in found[0].message
 
+    def test_stale_worker_table_is_a_finding(self):
+        """The drain scenario must exercise EXACTLY the declared
+        worker-lifecycle table — a declared-but-impossible edge
+        (ACTIVE→SUSPENDED skipping the drain) is a finding."""
+        m = default_manifest()
+        worker = next(mm for mm in m.state_machines
+                      if mm.name == "worker")
+        bloated = dataclasses.replace(
+            worker,
+            transitions=worker.transitions + (("ACTIVE", "SUSPENDED"),))
+        m2 = dataclasses.replace(
+            m, state_machines=tuple(
+                mm for mm in m.state_machines if mm.name != "worker")
+            + (bloated,))
+        found = statemachine.model_findings(m2)
+        assert codes(found) == ["TVT-M002"]
+        assert "worker-lifecycle" in found[0].message
+        assert "ACTIVE" in found[0].message
+
+    def test_worker_model_exercises_exactly_the_declared_table(self):
+        m = default_manifest()
+        worker = next(mm for mm in m.state_machines
+                      if mm.name == "worker")
+        violations, _edges, wedges = statemachine._explore_all(
+            m, None, (), statemachine.SCENARIOS)
+        assert violations == []
+        assert wedges == set(worker.transitions)
+
     @pytest.mark.parametrize("mutation,invariant", [
         ("double_assign", "single-assignment"),
         ("preempt_burns_attempt", "attempt-accounting"),
@@ -719,6 +747,11 @@ class TestBoardModel:
         ("shared_ids", "cross-run-part"),
         ("no_expiry", "open-shard-unreachable"),
         ("gate_ignored", "qos-gate"),
+        # worker-lifecycle machine (the elastic farm, ISSUE 12):
+        # claims must never reach a DRAINING/SUSPENDED worker, and a
+        # drain must never strand a lease by suspending under it
+        ("claim_while_draining", "lifecycle-claim"),
+        ("suspend_with_lease", "drain-strands-lease"),
     ])
     def test_seeded_mutation_yields_counterexample(self, mutation,
                                                    invariant):

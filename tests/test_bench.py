@@ -67,10 +67,14 @@ def test_bench_result_schema_includes_stage_ms():
            "stage_ms": {}}
     trace = {"fps_off": 33.5, "fps_on": 33.1, "overhead_pct": 1.2,
              "sampled": True}
+    autoscale = {"p99_queue_s": 4.2, "active_worker_s": 41.0,
+                 "alwayson_worker_s": 90.0, "jobs_done": 7,
+                 "peak_workers": 3, "kills": 2, "partitions": 1,
+                 "duration_s": 30.0}
     result = bench.build_result(r, r4k, platform="cpu", qp=27, gop=8,
                                 n_1080=64, cold=cold, ladder=ladder,
                                 live=live, origin=origin, sfe=sfe,
-                                trace=trace)
+                                trace=trace, autoscale=autoscale)
     assert result["value"] == 33.3
     assert set(STAGE_NAMES) <= set(result["stage_ms"])
     # sfe is a first-class stage key
@@ -124,6 +128,16 @@ def test_bench_result_schema_includes_stage_ms():
     # distributed-tracing cost on the e2e hot path is a pinned BENCH
     # key (acceptance gate: < 3% on the driver's run)
     assert result["trace_overhead_pct"] == 1.2
+    # elastic farm under chaos: p99 queued→dispatched wait and
+    # worker-seconds consumed vs always-on (the measurement raises
+    # inside _run_autoscale unless active < always-on, so the pinned
+    # pair is the breathing proof)
+    assert result["autoscale_p99_queue_s"] == 4.2
+    assert result["farm_active_worker_s"] == 41.0
+    assert result["farm_alwayson_worker_s"] == 90.0
+    assert result["autoscale_jobs_done"] == 7
+    assert result["chaos_worker_kills"] == 2
+    assert result["chaos_partitions"] == 1
 
 
 def test_run_trace_overhead_measures_both_paths():
@@ -181,6 +195,25 @@ def test_run_origin_serves_mixed_load():
     assert r["live_latency_under_load_s"] > 0
     assert r["requests"] > 0 and r["errors"] <= 2
     assert r["origin_hits"] > 0        # hot segments came from memory
+
+
+@pytest.mark.slow
+def test_run_autoscale_breathes_under_chaos():
+    """The autoscale bench drives the PRODUCTION elastic farm: real
+    worker subprocesses scaled from zero by the capacity controller
+    against a diurnal submission curve, one SIGKILL and one /work
+    partition. Small here (2 workers max, short window); the driver's
+    run uses the full curve. The measurement itself raises unless
+    every job reaches DONE byte-identical AND the farm's
+    worker-seconds land below always-on."""
+    r = bench._run_autoscale(64, 48, 8, qp=27, gop_frames=2,
+                             duration_s=10.0, hi_rps=0.4, farm_max=2,
+                             kill_interval_s=6.0, partition_s=2.0)
+    assert r["jobs_done"] >= 1
+    assert r["p99_queue_s"] >= 0.0
+    assert 0 < r["active_worker_s"] < r["alwayson_worker_s"]
+    assert r["kills"] >= 1
+    assert r["partitions"] == 1
 
 
 def test_run_ladder_reports_aggregate_and_shared_upload():

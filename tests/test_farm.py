@@ -1,0 +1,617 @@
+"""Elastic multi-tenant farm tests (thinvids_tpu/farm/).
+
+Four layers:
+
+- `TestTenancy` / `TestFairShare`: tenant parsing and the weighted
+  fair-share admission at BOTH points (ShardBoard.claim and the
+  coordinator's dispatch pass).
+- `TestController`: the CapacityController's lifecycle decisions on a
+  fake clock with a recording provider — scale-up from zero, drain
+  completes in-flight shards before suspend, drain-grace requeue (no
+  attempt burned), wake timeout, crashed-worker absorption, the
+  claim gate, and energy accounting.
+- `TestChaos`: the loadgen chaos harness (diurnal curve, kills,
+  /work partition) on injected clocks.
+- `test_subprocess_provider_end_to_end`: the hermetic acceptance rig —
+  a real coordinator + HTTP API with the controller spawning a REAL
+  ``cli.py worker`` subprocess from scale-to-zero, the job reaching
+  DONE, and the scale-down draining and killing the daemon
+  (alongside tests/test_remote.py's 2-worker farm rig).
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from thinvids_tpu.cluster import Coordinator, WorkerRegistry
+from thinvids_tpu.cluster.remote import RemoteExecutor, Shard, ShardBoard
+from thinvids_tpu.core.config import DEFAULT_SETTINGS, Settings
+from thinvids_tpu.core.status import ShardState, Status
+from thinvids_tpu.core.types import GopSpec, VideoMeta
+from thinvids_tpu.farm import (
+    CallableProvider,
+    CapacityController,
+    WorkerState,
+    clean_tenant,
+    parse_tenant_shares,
+    render_tenant_shares,
+    tenant_of,
+)
+from thinvids_tpu.tools import loadgen
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class RecordingProvider(CallableProvider):
+    """Provider that records calls; wake/suspend outcomes injectable."""
+
+    def __init__(self, wake_ok=True, suspend_ok=True):
+        self.woken: list[str] = []
+        self.suspended: list[str] = []
+        self.wake_ok = wake_ok
+        self.suspend_ok = suspend_ok
+
+    def wake(self, host):
+        self.woken.append(host)
+        return self.wake_ok
+
+    def suspend(self, host):
+        self.suspended.append(host)
+        return self.suspend_ok
+
+
+def make_settings(**over):
+    values = dict(DEFAULT_SETTINGS)
+    values.update(over)
+    return Settings(values=values)
+
+
+def make_shard(sid="j0-0000", job_id="j0", gop0=0, ngops=1,
+               timeout_s=60.0, tenant="default", priority=2):
+    gops = tuple(GopSpec(index=gop0 + i, start_frame=2 * (gop0 + i),
+                         num_frames=2) for i in range(ngops))
+    return Shard(id=sid, job_id=job_id, input_path="/in/a.y4m",
+                 meta=VideoMeta(width=64, height=48), gops=gops, qp=30,
+                 gop_frames=2, timeout_s=timeout_s, tenant=tenant,
+                 priority=priority)
+
+
+def make_rig(clock=None, workers=("w1", "w2"), **over):
+    """Coordinator + board + controller on one fake clock; every host
+    in `workers` heartbeats as a claim-capable daemon."""
+    clock = clock or FakeClock()
+    over.setdefault("pipeline_worker_count", len(workers) or 1)
+    snap = make_settings(min_idle_workers=0, **over)
+    reg = WorkerRegistry(clock=clock)
+    for hostname in workers:
+        reg.heartbeat(hostname, metrics={"worker": True}, now=clock())
+    coord = Coordinator(registry=reg, clock=clock,
+                        settings_fn=lambda: snap)
+    board = ShardBoard(coord, clock=clock)
+    provider = RecordingProvider()
+    farm = CapacityController(coord, provider=provider, board=board,
+                              clock=clock)
+    coord.farm = farm
+    return coord, board, farm, provider, clock
+
+
+# ---------------------------------------------------------------------------
+# tenancy + fair share
+# ---------------------------------------------------------------------------
+
+
+class TestTenancy:
+    def test_tenant_from_filename_prefix(self):
+        assert tenant_of("/watch/acme__clip.y4m") == "acme"
+        assert tenant_of("/watch/acme__clip.ladder.y4m") == "acme"
+        assert tenant_of("/watch/clip.y4m") == "default"
+        # single underscore is NOT a tenant separator
+        assert tenant_of("/watch/my_clip.y4m") == "default"
+        # a bare "__x" prefix has no tenant name
+        assert tenant_of("/watch/__clip.y4m") == "default"
+
+    def test_explicit_tenant_wins_and_sanitizes(self):
+        assert tenant_of("/watch/acme__clip.y4m", "Bravo!") == "bravo"
+        assert clean_tenant("  UPPER-case_9  ") == "upper-case_9"
+        assert clean_tenant("%$#") == "default"
+
+    def test_shares_parse_and_render(self):
+        shares = parse_tenant_shares("acme:3, bravo:1, bad:x, :2")
+        assert shares["acme"] == 3.0 and shares["bravo"] == 1.0
+        assert "bad" not in shares
+        assert render_tenant_shares("bravo:1,acme:3") == \
+            "acme:3,bravo:1"
+        # zero/negative weights floor at a tiny positive share
+        assert parse_tenant_shares("acme:0")["acme"] > 0
+
+    def test_job_registration_resolves_tenant(self, tmp_path):
+        coord = Coordinator(settings_fn=lambda: make_settings(
+            auto_start_jobs=False))
+        meta = VideoMeta(width=64, height=48, num_frames=4)
+        j1 = coord.add_job("/in/acme__a.y4m", meta)
+        j2 = coord.add_job("/in/b.y4m", meta,
+                           settings={"tenant": "bravo"})
+        j3 = coord.add_job("/in/c.y4m", meta)
+        assert j1.tenant == "acme"
+        assert j2.tenant == "bravo"
+        assert j3.tenant == "default"
+
+
+class TestFairShare:
+    def test_claim_interleaves_tenants(self):
+        """An early flood from one tenant must not starve the other:
+        with equal shares the claim alternates tenants even though
+        every acme shard is older in FIFO order."""
+        coord, board, farm, _p, _c = make_rig(workers=("w1", "w2"),
+                                              pipeline_worker_count=1)
+        shards = [make_shard(sid=f"a-{i}", job_id="ja", tenant="acme")
+                  for i in range(4)]
+        shards += [make_shard(sid=f"b-{i}", job_id="jb",
+                              tenant="bravo") for i in range(2)]
+        board.add_job("ja", shards[:4], max_attempts=3, backoff_s=0.0,
+                      quarantine_after=9)
+        board.add_job("jb", shards[4:], max_attempts=3, backoff_s=0.0,
+                      quarantine_after=9)
+        got = [board.claim("w2")["id"] for _ in range(4)]
+        tenants = ["acme" if g.startswith("a-") else "bravo"
+                   for g in got]
+        # usage balances 1:1 — strict FIFO would have been
+        # [acme, acme, acme, acme]
+        assert tenants == ["acme", "bravo", "acme", "bravo"]
+
+    def test_claim_honors_weighted_shares(self):
+        coord, board, farm, _p, _c = make_rig(
+            workers=("w1", "w2"), pipeline_worker_count=1,
+            tenant_shares="acme:3,bravo:1")
+        a = [make_shard(sid=f"a-{i}", job_id="ja", tenant="acme")
+             for i in range(6)]
+        b = [make_shard(sid=f"b-{i}", job_id="jb", tenant="bravo")
+             for i in range(6)]
+        board.add_job("ja", a, max_attempts=3, backoff_s=0.0,
+                      quarantine_after=9)
+        board.add_job("jb", b, max_attempts=3, backoff_s=0.0,
+                      quarantine_after=9)
+        got = [board.claim("w2")["id"] for _ in range(4)]
+        acme = sum(1 for g in got if g.startswith("a-"))
+        # 3:1 weighting → acme takes 3 of the first 4 leases
+        assert acme == 3
+
+    def test_priority_class_still_dominates_tenancy(self):
+        """Fair share is WITHIN a class: a live-class shard from the
+        most-overserved tenant still beats any batch shard."""
+        coord, board, farm, _p, _c = make_rig(workers=("w1", "w2"),
+                                              pipeline_worker_count=1)
+        board.add_job("jb", [make_shard(sid="b-0", job_id="jb",
+                                        tenant="bravo", priority=2)],
+                      max_attempts=3, backoff_s=0.0, quarantine_after=9)
+        board.add_job("ja", [
+            make_shard(sid=f"a-{i}", job_id="ja", tenant="acme",
+                       priority=0) for i in range(2)],
+            max_attempts=3, backoff_s=0.0, quarantine_after=9)
+        got = [board.claim("w2")["id"] for _ in range(2)]
+        assert got == ["a-0", "a-1"]
+
+    def test_dispatch_picks_underserved_tenant(self):
+        """The coordinator's dispatch pass applies the same weighted
+        key: with an acme job already active, bravo's older queue
+        position wins the next slot."""
+        launched = []
+        snap = make_settings(auto_start_jobs=False, max_active_jobs=2,
+                             pipeline_worker_count=8,
+                             min_idle_workers=0)
+        reg = WorkerRegistry()
+        for i in range(8):
+            reg.heartbeat(f"n{i}", metrics={"devices": 1})
+        coord = Coordinator(registry=reg, settings_fn=lambda: snap,
+                            launcher=lambda j: launched.append(j))
+        meta = VideoMeta(width=64, height=48, num_frames=4)
+        ja = coord.add_job("/in/acme__a.y4m", meta)
+        jb = coord.add_job("/in/acme__b.y4m", meta)
+        jc = coord.add_job("/in/bravo__c.y4m", meta)
+        coord.queue_job(ja.id)
+        coord.queue_job(jb.id)
+        coord.queue_job(jc.id)
+        first = coord.dispatch_next_waiting_job()
+        assert first.id == ja.id          # empty usage: FIFO
+        # make ja shareable (RUNNING, segmented, drained) so the
+        # admission gate lets a neighbor in
+        token = coord.store.get(ja.id).run_token
+        coord.mark_running(ja.id, token)
+        coord.update_progress(ja.id, token, segment_progress=100.0,
+                              parts_total=1, parts_done=1)
+        second = coord.dispatch_next_waiting_job()
+        # acme already holds a slot → bravo's job jumps acme's older one
+        assert second is not None and second.id == jc.id
+
+    def test_board_tenant_accounting_surfaces(self):
+        coord, board, farm, _p, _c = make_rig(workers=("w1", "w2"),
+                                              pipeline_worker_count=1)
+        board.add_job("ja", [make_shard(sid="a-0", job_id="ja",
+                                        tenant="acme")],
+                      max_attempts=3, backoff_s=0.0, quarantine_after=9)
+        board.claim("w2")
+        assert board.tenant_assigned() == {"acme": 1}
+        snap = board.snapshot()
+        assert snap["tenants"]["acme"]["assigned"] == 1
+
+
+# ---------------------------------------------------------------------------
+# capacity controller
+# ---------------------------------------------------------------------------
+
+
+class TestController:
+    def test_discovers_live_workers_as_active(self):
+        coord, board, farm, prov, clock = make_rig()
+        out = farm.tick()
+        assert out["counts"]["active"] == 2
+        assert farm.lifecycle_of("w1") is WorkerState.ACTIVE
+
+    def test_waiting_job_demand_wakes_from_zero(self):
+        """Scale-to-zero wake path: no workers exist, a WAITING job
+        appears → the controller provisions a fresh host through the
+        provider and tracks it WAKING; its first heartbeat lands it
+        ACTIVE."""
+        coord, board, farm, prov, clock = make_rig(
+            workers=(), autoscale_enabled=True, farm_max_workers=3)
+        meta = VideoMeta(width=64, height=48, num_frames=4)
+        job = coord.add_job("/in/a.y4m", meta, auto_start=False)
+        coord.queue_job(job.id)
+        out = farm.tick()
+        assert out["want"] == 1 and prov.woken
+        host = prov.woken[0]
+        assert farm.lifecycle_of(host) is WorkerState.WAKING
+        # first heartbeat AFTER the wake → ACTIVE
+        clock.advance(1.0)
+        coord.registry.heartbeat(host, metrics={"worker": True},
+                                 now=clock())
+        farm.tick()
+        assert farm.lifecycle_of(host) is WorkerState.ACTIVE
+
+    def test_pending_shards_scale_with_class_weight(self):
+        coord, board, farm, prov, clock = make_rig(
+            workers=(), autoscale_enabled=True, farm_max_workers=8)
+        board.add_job("j0", [make_shard(sid=f"s{i}", gop0=i,
+                                        priority=0) for i in range(2)],
+                      max_attempts=3, backoff_s=0.0, quarantine_after=9)
+        out = farm.tick()
+        # 2 live-class shards x weight 4 / 2-per-worker = 4 workers
+        assert out["demand"] == 4
+        assert len(prov.woken) == 4
+
+    def test_idle_farm_drains_then_suspends(self):
+        coord, board, farm, prov, clock = make_rig(
+            autoscale_enabled=True, farm_min_workers=0,
+            drain_grace_s=30.0)
+        farm.tick()                       # discover w1/w2 ACTIVE
+        out = farm.tick()                 # no demand → drain both
+        assert farm.lifecycle_of("w1") is WorkerState.SUSPENDED \
+            or "w1" in out["suspended"]
+        assert sorted(prov.suspended) == ["w1", "w2"]
+        # claims now refused outright
+        board.add_job("j0", [make_shard()], max_attempts=3,
+                      backoff_s=0.0, quarantine_after=9)
+        assert board.claim("w1") is None
+
+    def test_min_workers_floor_holds(self):
+        coord, board, farm, prov, clock = make_rig(
+            autoscale_enabled=True, farm_min_workers=1)
+        farm.tick()
+        farm.tick()
+        counts = farm.snapshot()["counts"]
+        assert counts["active"] == 1 and counts["suspended"] == 1
+
+    def test_drain_finishes_inflight_before_suspend(self):
+        """The graceful-drain contract: a DRAINING worker keeps its
+        lease, stops claiming, and suspend fires only once the lease
+        set empties."""
+        coord, board, farm, prov, clock = make_rig(
+            workers=("w1",), pipeline_worker_count=1,
+            autoscale_enabled=True, farm_min_workers=0,
+            drain_grace_s=1000.0)
+        shard = make_shard()
+        board.add_job("j0", [shard], max_attempts=3, backoff_s=0.0,
+                      quarantine_after=9)
+        farm.tick()                         # w1 ACTIVE
+        desc = board.claim("w1")
+        assert desc is not None
+        farm.tick()                         # demand 0 → drain w1
+        assert farm.lifecycle_of("w1") is WorkerState.DRAINING
+        assert board.claim("w1") is None    # stops claiming
+        farm.tick()                         # lease still held
+        assert prov.suspended == []
+        assert farm.lifecycle_of("w1") is WorkerState.DRAINING
+        from tests.test_remote import fake_segment
+
+        board.submit_part(desc["id"], "w1", [fake_segment(0, 0, 2)])
+        farm.tick()                         # lease set empty → suspend
+        assert prov.suspended == ["w1"]
+        assert farm.lifecycle_of("w1") is WorkerState.SUSPENDED
+
+    def test_drain_grace_requeues_without_attempt_burn(self):
+        coord, board, farm, prov, clock = make_rig(
+            workers=("w1",), pipeline_worker_count=1,
+            autoscale_enabled=True, farm_min_workers=0,
+            drain_grace_s=10.0)
+        board.add_job("j0", [make_shard(timeout_s=9999.0)],
+                      max_attempts=3, backoff_s=0.0, quarantine_after=9)
+        farm.tick()
+        board.claim("w1")
+        farm.tick()                         # drain
+        clock.advance(11.0)
+        coord.registry.heartbeat("w1", metrics={"worker": True},
+                                 now=clock())   # host alive, just stuck
+        farm.tick()                         # grace expired → requeue
+        shard = board._find_locked("j0-0000")
+        assert shard.state is ShardState.PENDING
+        assert shard.attempt == 0           # NO attempt burned
+        assert shard.not_before == 0.0      # no backoff either
+        assert prov.suspended == ["w1"]
+
+    def test_wake_timeout_falls_back_to_suspended(self):
+        coord, board, farm, prov, clock = make_rig(
+            workers=(), autoscale_enabled=True, farm_max_workers=1,
+            drain_grace_s=10.0)
+        meta = VideoMeta(width=64, height=48, num_frames=4)
+        job = coord.add_job("/in/a.y4m", meta, auto_start=False)
+        coord.queue_job(job.id)
+        farm.tick()
+        host = prov.woken[0]
+        assert farm.lifecycle_of(host) is WorkerState.WAKING
+        clock.advance(11.0)                 # wake never heartbeats
+        # the timeout drops the host back to SUSPENDED and — demand
+        # persisting — the SAME tick's plan fires a retry wake
+        farm.tick()
+        assert prov.woken.count(host) == 2
+        assert farm.lifecycle_of(host) is WorkerState.WAKING
+        # with the demand gone, the next timeout parks it SUSPENDED
+        coord.stop_job(job.id)
+        clock.advance(11.0)
+        farm.tick()
+        assert farm.lifecycle_of(host) is WorkerState.SUSPENDED
+
+    def test_crashed_active_worker_is_absorbed(self):
+        """SIGKILLed worker: heartbeat goes stale → drained; a dark
+        host's drain completes WITHOUT provider confirmation, so the
+        next tick's demand can wake a replacement."""
+        coord, board, farm, prov, clock = make_rig(
+            workers=("w1",), pipeline_worker_count=1,
+            autoscale_enabled=True, farm_min_workers=0,
+            metrics_ttl_s=15.0)
+        prov.suspend_ok = False             # dead process: no handle
+        # standing demand keeps w1 wanted (and re-wakes a replacement)
+        board.add_job("j0", [make_shard(sid=f"s{i}", gop0=i)
+                             for i in range(4)],
+                      max_attempts=3, backoff_s=0.0, quarantine_after=9)
+        farm.tick()
+        assert farm.lifecycle_of("w1") is WorkerState.ACTIVE
+        clock.advance(20.0)                 # TTL lapses (crash)
+        farm.tick()                         # dark host drains; its
+        # drain completes WITHOUT provider confirmation (not live),
+        # and the standing demand provisions replacements in the same
+        # pass — the chaos-kill absorption loop
+        assert farm.lifecycle_of("w1") is WorkerState.SUSPENDED
+        assert len(prov.woken) >= 1
+
+    def test_claim_promotes_waking_worker(self):
+        coord, board, farm, prov, clock = make_rig(
+            workers=("w1",), pipeline_worker_count=1,
+            autoscale_enabled=True, farm_min_workers=0)
+        farm.tick()
+        farm.tick()                         # idle → drain+suspend w1
+        assert farm.lifecycle_of("w1") is WorkerState.SUSPENDED
+        board.add_job("j0", [make_shard()], max_attempts=3,
+                      backoff_s=0.0, quarantine_after=9)
+        farm.tick()                         # demand → wake w1
+        assert farm.lifecycle_of("w1") is WorkerState.WAKING
+        # the worker's own claim is proof it is up: promoted + served
+        coord.registry.heartbeat("w1", metrics={"worker": True},
+                                 now=clock())
+        assert board.claim("w1") is not None
+        assert farm.lifecycle_of("w1") is WorkerState.ACTIVE
+
+    def test_autoscale_disabled_keeps_hands_off(self):
+        coord, board, farm, prov, clock = make_rig(
+            autoscale_enabled=False)
+        farm.tick()
+        farm.tick()
+        assert prov.suspended == [] and prov.woken == []
+        assert farm.snapshot()["counts"]["active"] == 2
+
+    def test_active_worker_seconds_accumulate_only_while_on(self):
+        coord, board, farm, prov, clock = make_rig(
+            workers=("w1",), pipeline_worker_count=1,
+            autoscale_enabled=True, farm_min_workers=0)
+        # standing demand keeps w1 ACTIVE through the accrual window
+        board.add_job("j0", [make_shard()], max_attempts=3,
+                      backoff_s=0.0, quarantine_after=9)
+        farm.tick()
+        clock.advance(10.0)
+        coord.registry.heartbeat("w1", metrics={"worker": True},
+                                 now=clock())
+        farm.tick()                         # 10 s ACTIVE
+        board.cancel_job("j0")              # demand gone
+        clock.advance(5.0)
+        coord.registry.heartbeat("w1", metrics={"worker": True},
+                                 now=clock())
+        farm.tick()                         # +5 s, then drain+suspend
+        base = farm.active_worker_seconds()
+        assert base == pytest.approx(15.0)
+        assert farm.lifecycle_of("w1") is WorkerState.SUSPENDED
+        clock.advance(100.0)
+        farm.tick()                         # suspended: no accrual
+        assert farm.active_worker_seconds() == pytest.approx(base)
+
+    def test_flight_record_carries_tenant(self, tmp_path):
+        """Satellite: a failed job's postmortem artifact attributes
+        the incident to its tenant next to the settings snapshot."""
+        import json
+
+        from thinvids_tpu.obs import flight, trace
+
+        trace.TRACE.start("jobt")
+        trace.TRACE.record_error("jobt", "boom")
+        path = flight.record("jobt", reason="test failure",
+                             out_dir=str(tmp_path),
+                             settings={"qp": 27}, tenant="acme")
+        assert path is not None
+        with open(path, encoding="utf-8") as fp:
+            doc = json.load(fp)
+        assert doc["otherData"]["tenant"] == "acme"
+        assert doc["otherData"]["settings"]["qp"] == 27
+        trace.TRACE.drop("jobt")
+
+    def test_snapshot_and_metrics_surface(self):
+        from thinvids_tpu.api.server import ApiServer
+
+        coord, board, farm, prov, clock = make_rig()
+        farm.tick()
+        api = ApiServer(coord, work=board)
+        _status, snap = api.route("GET", "/metrics_snapshot", {}, {})
+        assert snap["farm"]["counts"]["active"] == 2
+        _status, text = api.route("GET", "/metrics", {}, {})
+        body = text.body.decode("utf-8")
+        assert 'tvt_farm_workers{lifecycle="active"} 2' in body
+        assert "tvt_tenant_active_shards" in body
+        assert 'tvt_jobs{tenant="default",status="done"}' in body
+
+
+# ---------------------------------------------------------------------------
+# chaos harness
+# ---------------------------------------------------------------------------
+
+
+class TestChaos:
+    def test_diurnal_rate_shape(self):
+        assert loadgen.diurnal_rate(0.0, 60.0, 0.0, 2.0) == \
+            pytest.approx(0.0)
+        assert loadgen.diurnal_rate(30.0, 60.0, 0.0, 2.0) == \
+            pytest.approx(2.0)
+        assert loadgen.diurnal_rate(60.0, 60.0, 0.0, 2.0) == \
+            pytest.approx(0.0, abs=1e-9)
+        mid = loadgen.diurnal_rate(15.0, 60.0, 1.0, 3.0)
+        assert 1.0 < mid < 3.0
+
+    def test_run_chaos_load_fires_everything(self):
+        clock = {"t": 0.0}
+
+        def fake_clock():
+            return clock["t"]
+
+        def fake_sleep(_s):
+            clock["t"] += 0.5
+
+        submitted, kills = [], []
+        out = loadgen.run_chaos_load(
+            lambda i: submitted.append(i), 20.0, period_s=20.0,
+            lo_rps=0.0, hi_rps=1.0,
+            kill=lambda: kills.append(1) or True, kill_interval_s=8.0,
+            partition=lambda s: kills.append(("part", s)),
+            partition_s=2.0, clock=fake_clock, sleep=fake_sleep)
+        assert out["submitted"] == len(submitted) > 0
+        assert out["kills"] >= 1
+        assert out["partitions"] == 1
+        assert ("part", 2.0) in kills
+
+    def test_api_partition_blackholes_work_routes(self):
+        from thinvids_tpu.api.server import ApiError, ApiServer
+
+        coord, board, farm, _p, _c = make_rig()
+        api = ApiServer(coord, work=board)
+        board.add_job("j0", [make_shard()], max_attempts=3,
+                      backoff_s=0.0, quarantine_after=9)
+        api.partition_work(30.0)
+        with pytest.raises(ApiError) as ei:
+            api.route("POST", "/work/claim", {}, {"host": "w2"})
+        assert ei.value.status == 503
+        api.partition_work(0.0)            # heal
+        status, out = api.route("POST", "/work/claim", {},
+                                {"host": "w2"})
+        assert status == 200 and out["shard"] is not None
+
+
+# ---------------------------------------------------------------------------
+# hermetic subprocess-provider acceptance rig
+# ---------------------------------------------------------------------------
+
+
+def test_subprocess_provider_end_to_end(tmp_path):
+    """Scale-to-zero → wake a REAL worker daemon → job DONE → drain →
+    suspend kills the daemon. The farm analog of test_remote.py's
+    2-worker rig, with the controller doing the spawning."""
+    from tests.test_remote import write_clip
+
+    from thinvids_tpu.api.server import ApiServer
+    from thinvids_tpu.farm import SubprocessProvider
+
+    clip = tmp_path / "clip.y4m"
+    meta = write_clip(clip, n=8)
+    snap = make_settings(
+        gop_frames=2, qp=30, heartbeat_throttle_s=0.0,
+        execution_backend="remote", autoscale_enabled=True,
+        farm_min_workers=0, farm_max_workers=1, drain_grace_s=20.0,
+        pipeline_worker_count=1, min_idle_workers=0,
+        scheduler_poll_s=0.25, metrics_ttl_s=5.0,
+        remote_plan_devices=4, remote_shard_gops=2,
+        remote_no_worker_grace_s=120.0)
+    coord = Coordinator(settings_fn=lambda: snap)
+    execu = RemoteExecutor(coord, output_dir=str(tmp_path / "lib"),
+                           sync=False, poll_s=0.1)
+    coord._launcher = execu.launch
+    api = ApiServer(coord, work=execu.board).start()
+    provider = SubprocessProvider(
+        api.url, env=dict(os.environ, JAX_PLATFORMS="cpu",
+                          PYTHONPATH=REPO))
+    farm = CapacityController(coord, provider=provider,
+                              board=execu.board)
+    coord.farm = farm
+    farm.start(poll_s=0.3)
+    coord.start_background()
+    try:
+        job = coord.add_job(str(clip), meta)
+
+        seen_hosts: set[str] = set()
+
+        def wait_for(pred, budget, what):
+            deadline = time.time() + budget
+            while time.time() < deadline:
+                seen_hosts.update(provider.hosts())
+                if pred():
+                    return
+                time.sleep(0.2)
+            raise TimeoutError(what)
+
+        # the farm wakes from zero and the job lands DONE
+        wait_for(lambda: coord.store.get(job.id).status
+                 in (Status.DONE, Status.FAILED), 180,
+                 "job terminal")
+        done = coord.store.get(job.id)
+        assert done.status is Status.DONE, done.failure_reason
+        assert seen_hosts, "no worker daemon was ever spawned"
+        host = sorted(seen_hosts)[0]
+        # demand is gone: the controller drains and SUSPENDS the
+        # daemon (SIGTERM through the provider — process exits)
+        wait_for(lambda: farm.lifecycle_of(host)
+                 is WorkerState.SUSPENDED, 60, "scale-down")
+        wait_for(lambda: not provider.hosts(), 30,
+                 "daemon process exit")
+        assert farm.active_worker_seconds() > 0
+    finally:
+        coord.stop_background()
+        farm.stop()
+        provider.stop_all()
+        api.stop()
+        execu.join(30)

@@ -360,6 +360,32 @@ class TestShardBoard:
         assert all("bbbb22" in sid for sid in ids_b)
         assert not set(ids_a) & set(ids_b)
 
+    def test_stale_worker_claim_denied_until_real_heartbeat(self):
+        """Regression (ISSUE 12 satellite): a worker whose heartbeat
+        TTL lapsed used to revive itself through claim()'s
+        unconditional pre-check heartbeat and win a shard — racing
+        requeue_expired's pre-lock active-set snapshot, which then
+        swept the fresh lease and burned an attempt. Liveness is now
+        re-checked under the lock from the registry's current state,
+        and only a GRANTED claim refreshes it: a stale worker's poll
+        returns None until its agent actually heartbeats again."""
+        board, coord, clock = make_board()
+        shards = [make_shard(sid=f"j0-{i:04d}", gop0=2 * i)
+                  for i in range(2)]
+        board.add_job("j0", shards, max_attempts=3, backoff_s=0.0,
+                      quarantine_after=9)
+        assert board.claim("w2") is not None      # fresh: wins
+        clock.advance(20.0)                       # > metrics_ttl_s 15
+        # stale worker asks for more work: denied, NOT revived
+        assert board.claim("w2") is None
+        workers = {w.host: w for w in coord.registry.all()}
+        assert clock() - workers["w2"].last_seen > 15.0
+        # the sweep judges the stale lease without interference
+        assert board.requeue_expired() == ["j0-0000"]
+        # a real agent heartbeat restores eligibility
+        coord.registry.heartbeat("w2", now=clock())
+        assert board.claim("w2") is not None
+
     def test_snapshot_carries_timings(self):
         board, coord, clock = make_board()
         board.add_job("j0", [make_shard()], max_attempts=3, backoff_s=0.0,

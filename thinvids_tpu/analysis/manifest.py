@@ -130,6 +130,34 @@ JOB_MACHINE = StateMachine(
     },
 )
 
+#: the elastic-farm worker lifecycle (farm/lifecycle.py, driven by
+#: farm/controller.py): ACTIVE workers claim; DRAINING workers finish
+#: in-flight shards but stop claiming; SUSPENDED workers are powered
+#: down; WAKING workers have a wake in flight. Every `lifecycle` write
+#: site in farm/ is audited (TVT-M001), and the TVT-M002 explorer's
+#: `drain` scenario drives this machine against the shard board:
+#: no shard is ever leased to a DRAINING/SUSPENDED worker, and a
+#: suspend never fires while the worker still holds a lease.
+#: WAKING is a legal construction-time state: a freshly PROVISIONED
+#: host's first record is born with its wake already in flight.
+WORKER_MACHINE = StateMachine(
+    name="worker",
+    enum="WorkerState",
+    attr="lifecycle",
+    scope=("thinvids_tpu.farm",),
+    states=("ACTIVE", "DRAINING", "SUSPENDED", "WAKING"),
+    initial=("ACTIVE", "WAKING"),
+    transitions=(
+        ("ACTIVE", "DRAINING"),      # scale-down / crashed-host drain
+        ("DRAINING", "ACTIVE"),      # demand returned: cancel the drain
+        ("DRAINING", "SUSPENDED"),   # lease set empty: suspend fired
+        ("SUSPENDED", "WAKING"),     # scale-up: wake fired
+        ("WAKING", "ACTIVE"),        # first heartbeat / first claim
+        ("WAKING", "SUSPENDED"),     # wake never landed: retry later
+        ("SUSPENDED", "ACTIVE"),     # operator-started host rejoined
+    ),
+)
+
 #: the QoS batch gate (cluster/qos.py): OPEN admits batch claims,
 #: PREEMPTING withholds them. No AST-audited attribute (the controller
 #: keeps the state as an Event + breached set); the TVT-M002 board
@@ -178,6 +206,10 @@ class Manifest:
         # flight recorder) runs on coordinator/worker control-plane
         # threads and inside jax-free sidecars
         "thinvids_tpu.obs",             # whole package
+        # the elastic farm (capacity controller, lifecycle, provider
+        # seam, tenancy) is pure control plane: it spawns and kills
+        # worker PROCESSES but never touches a device itself
+        "thinvids_tpu.farm",            # whole package
         # self-hosting: the analyzer itself runs inside tier-1 as a
         # fast jax-free subprocess
         "thinvids_tpu.analysis",
@@ -271,6 +303,8 @@ class Manifest:
             "thinvids_tpu.cluster.coordinator:Coordinator._active_ids":
                 "_sched_lock",
             "thinvids_tpu.cluster.qos:QosController._breached": "_lock",
+            "thinvids_tpu.farm.controller:CapacityController._recs":
+                "_lock",
         })
 
     # -- pass 5: protocol state machines (TVT-M001/M002) --------------
@@ -278,7 +312,7 @@ class Manifest:
     #: checks write sites against, and the bounded explorer validates
     #: the board model against (see analysis/statemachine.py).
     state_machines: tuple[StateMachine, ...] = (
-        SHARD_MACHINE, JOB_MACHINE, QOS_GATE_MACHINE)
+        SHARD_MACHINE, JOB_MACHINE, QOS_GATE_MACHINE, WORKER_MACHINE)
 
     # -- pass 6: jit/retrace discipline (TVT-X001/X002) ---------------
     #: modules allowed to DEFINE `jax.jit` entry points — the repo's

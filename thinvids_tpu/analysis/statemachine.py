@@ -354,6 +354,11 @@ def _member_of(node: ast.AST, machine: StateMachine) -> str | None:
 PENDING, ASSIGNED, DONE, FAILED = "PENDING", "ASSIGNED", "DONE", "FAILED"
 _OPEN = (PENDING, ASSIGNED)
 
+# worker lifecycle states (the fourth declared machine — the elastic
+# farm's ACTIVE → DRAINING → SUSPENDED → WAKING loop, farm/)
+ACTIVE, DRAINING, SUSPENDED, WAKING = \
+    "ACTIVE", "DRAINING", "SUSPENDED", "WAKING"
+
 #: every seedable protocol break the model understands; tests assert
 #: the explorer produces a counterexample for each one
 MUTATIONS = (
@@ -365,6 +370,9 @@ MUTATIONS = (
     "shared_ids",            # shard ids not run-scoped across restarts
     "no_expiry",             # requeue_expired never fires
     "gate_ignored",          # claims ignore the closed QoS batch gate
+    "claim_while_draining",  # claims ignore the worker lifecycle gate
+    "suspend_with_lease",    # suspend fires while the worker holds a
+                             # lease (drain strands it)
 )
 
 
@@ -394,10 +402,14 @@ class Violation:
 
 # State layout (all tuples — hashable, structurally comparable):
 #   (t, run, entry_run|None, shards, workers, gate_open, fails,
-#    collected)
+#    collected, lifecycles)
 # shard: (state, attempt, host|"", deadline, not_before, finisher|"",
 #         seq)
 # worker: None (idle) | (shard_idx, descriptor_run, lease_seq)
+# lifecycle: ACTIVE | DRAINING | SUSPENDED | WAKING per worker (the
+#            farm machine; scenarios without lifecycle actions leave
+#            every worker ACTIVE, which collapses to the old state
+#            space)
 
 _FRESH_SHARD = (PENDING, 0, "", 0, 0, "", 0)
 #: shard tuple field order, resolved once (apply() updates fields by
@@ -409,7 +421,8 @@ _FIELD_IDX = {name: i for i, name in enumerate(
 
 def _initial(cfg: ModelConfig):
     return (0, 1, 1, (_FRESH_SHARD,) * cfg.shards,
-            (None,) * cfg.workers, True, 0, False)
+            (None,) * cfg.workers, True, 0, False,
+            (ACTIVE,) * cfg.workers)
 
 
 class BoardModel:
@@ -427,15 +440,24 @@ class BoardModel:
 
     # -- action enumeration (deterministic order) ----------------------
 
+    def _may_claim(self, lifecycle: str) -> bool:
+        """Worker-lifecycle claim gate: only ACTIVE workers claim —
+        unless the `claim_while_draining` mutation disables the gate
+        (the seeded break the `lifecycle-claim` invariant catches)."""
+        return lifecycle == ACTIVE or (
+            "claim_while_draining" in self.mut and lifecycle == DRAINING)
+
     def enabled(self, s, actions: tuple[str, ...]) -> list[tuple]:
-        t, run, entry, shards, workers, gate, fails, collected = s
+        (t, run, entry, shards, workers, gate, fails, collected,
+         lifecycles) = s
         out: list[tuple] = []
         for act in actions:
             if act == "claim" and entry is not None and \
                     (gate or "gate_ignored" in self.mut):
                 if self._claimable(s) is not None:
                     out.extend(("claim", w) for w in range(len(workers))
-                               if workers[w] is None)
+                               if workers[w] is None
+                               and self._may_claim(lifecycles[w]))
             elif act in ("submit", "fail", "die"):
                 out.extend((act, w) for w in range(len(workers))
                            if workers[w] is not None)
@@ -460,10 +482,37 @@ class BoardModel:
                     all(sh[0] == DONE for sh in shards)
                     or "collect_partial" in self.mut):
                 out.append(("collect",))
+            # -- worker lifecycle (the farm machine's drive actions) --
+            elif act == "drain":
+                out.extend(("drain", w) for w in range(len(workers))
+                           if lifecycles[w] == ACTIVE)
+            elif act == "undrain":
+                out.extend(("undrain", w) for w in range(len(workers))
+                           if lifecycles[w] == DRAINING)
+            elif act == "suspend":
+                # the controller suspends only a DRAINED worker whose
+                # lease set is empty; the `suspend_with_lease` mutation
+                # drops the emptiness check (the seeded strand)
+                out.extend(("suspend", w) for w in range(len(workers))
+                           if lifecycles[w] == DRAINING
+                           and (workers[w] is None
+                                or "suspend_with_lease" in self.mut))
+            elif act == "wake":
+                out.extend(("wake", w) for w in range(len(workers))
+                           if lifecycles[w] == SUSPENDED)
+            elif act == "rejoin":
+                out.extend(("rejoin", w) for w in range(len(workers))
+                           if lifecycles[w] == WAKING)
+            elif act == "wake_fail":
+                out.extend(("wake_fail", w) for w in range(len(workers))
+                           if lifecycles[w] == WAKING)
+            elif act == "hb":
+                out.extend(("hb", w) for w in range(len(workers))
+                           if lifecycles[w] == SUSPENDED)
         return out
 
     def _claimable(self, s) -> int | None:
-        t, _run, _entry, shards, _w, _g, _f, _c = s
+        t, _run, _entry, shards, _w, _g, _f, _c, _lc = s
         for i, sh in enumerate(shards):
             open_enough = sh[0] == PENDING or (
                 "double_assign" in self.mut and sh[0] == ASSIGNED)
@@ -476,12 +525,15 @@ class BoardModel:
     def apply(self, s, action: tuple):
         """Returns (post_state, shard_edges, notes) where shard_edges
         is [(idx, pre, post)] for shards of the SAME entry and notes
-        carries per-action facts the invariants read."""
-        t, run, entry, shards, workers, gate, fails, collected = s
+        carries per-action facts the invariants read (including
+        `wedges`, the worker-lifecycle edges this action took)."""
+        (t, run, entry, shards, workers, gate, fails, collected,
+         lifecycles) = s
         cfg = self.cfg
         kind = action[0]
         notes: dict = {}
         edges: list[tuple[int, str, str]] = []
+        wedges: list[tuple[int, str, str]] = []
 
         def upd(i, **ch):
             nonlocal shards
@@ -493,11 +545,17 @@ class BoardModel:
             if "state" in ch:
                 edges.append((i, pre, ch["state"]))
 
+        def move(w, to):
+            nonlocal lifecycles
+            wedges.append((w, lifecycles[w], to))
+            lifecycles = lifecycles[:w] + (to,) + lifecycles[w + 1:]
+
         if kind == "claim":
             w = action[1]
             i = self._claimable(s)
             notes["claim_pre"] = shards[i][0]
             notes["gate_open"] = gate
+            notes["claim_lifecycle"] = lifecycles[w]
             seq = shards[i][6] + 1
             upd(i, state=ASSIGNED, host=f"w{w}",
                 deadline=min(t + cfg.timeout, cfg.t_max - 1), seq=seq)
@@ -569,10 +627,32 @@ class BoardModel:
             entry = None
             shards = ()
             collected = True
+        elif kind == "drain":
+            move(action[1], DRAINING)
+        elif kind == "undrain":
+            move(action[1], ACTIVE)
+        elif kind == "suspend":
+            w = action[1]
+            # suspend powers the host down: a lease still held (only
+            # reachable under the `suspend_with_lease` mutation) dies
+            # with the process and strands until the sweep — the exact
+            # hole the drain-strands-lease invariant names
+            notes["suspend_held_lease"] = workers[w] is not None
+            workers = workers[:w] + (None,) + workers[w + 1:]
+            move(w, SUSPENDED)
+        elif kind == "wake":
+            move(action[1], WAKING)
+        elif kind == "rejoin":
+            move(action[1], ACTIVE)
+        elif kind == "wake_fail":
+            move(action[1], SUSPENDED)
+        elif kind == "hb":
+            move(action[1], ACTIVE)
         else:  # pragma: no cover - enumeration and apply stay in sync
             raise AssertionError(f"unknown action {action}")
-        return ((t, run, entry, shards, workers, gate, fails, collected),
-                edges, notes)
+        notes["wedges"] = wedges
+        return ((t, run, entry, shards, workers, gate, fails, collected,
+                 lifecycles), edges, notes)
 
     def _burn(self, shards, i, t, fails):
         """One failure event against shard i (worker report or lease
@@ -595,9 +675,13 @@ class BoardModel:
 
 
 def _check_transition(pre, action, post, edges, notes,
-                      declared: frozenset) -> tuple[str, str] | None:
+                      declared: frozenset,
+                      wdeclared: frozenset | None = None
+                      ) -> tuple[str, str] | None:
     """(invariant, detail) for the first violated safety property of
-    one (pre --action--> post) transition, else None."""
+    one (pre --action--> post) transition, else None. `wdeclared` is
+    the worker-lifecycle machine's table (None = not declared; the
+    lifecycle checks then stay dormant)."""
     kind = action[0]
     if kind == "claim" and notes.get("claim_pre") != PENDING:
         return ("single-assignment",
@@ -606,6 +690,22 @@ def _check_transition(pre, action, post, edges, notes,
     if kind == "claim" and not notes.get("gate_open", True):
         return ("qos-gate",
                 "batch shard claimed while the QoS gate was closed")
+    if kind == "claim" and notes.get("claim_lifecycle", ACTIVE) != ACTIVE:
+        return ("lifecycle-claim",
+                f"shard leased to a {notes['claim_lifecycle']} worker "
+                f"(only ACTIVE workers may claim)")
+    if kind == "suspend" and notes.get("suspend_held_lease"):
+        return ("drain-strands-lease",
+                "suspend fired while the worker still held an open "
+                "lease — drain must wait for (or requeue) the lease "
+                "set first")
+    if wdeclared is not None:
+        for w, a, b in notes.get("wedges", ()):
+            if (a, b) not in wdeclared:
+                return ("undeclared-transition",
+                        f"worker w{w}: {a}→{b} via "
+                        f"{_fmt_action(action)} is not in the declared "
+                        f"worker-lifecycle table")
     # done-absorbs BEFORE the generic edge check: overwriting a DONE
     # shard must be named as the first-result-wins break it is, not as
     # a generic undeclared DONE→DONE edge
@@ -648,7 +748,8 @@ def _check_transition(pre, action, post, edges, notes,
 
 
 def _check_terminal(state) -> tuple[str, str] | None:
-    t, run, entry, shards, workers, gate, fails, collected = state
+    (t, run, entry, shards, workers, gate, fails, collected,
+     _lifecycles) = state
     if entry is None:
         return None
     open_ = [i for i, sh in enumerate(shards) if sh[0] in _OPEN]
@@ -670,11 +771,15 @@ def _fmt_action(action: tuple) -> str:
 
 @dataclasses.dataclass(frozen=True)
 class Scenario:
-    """One bounded exploration: which actions interleave, how deep."""
+    """One bounded exploration: which actions interleave, how deep.
+    `cfg` (when set) overrides the ModelConfig for this scenario —
+    the drain scenario trades shard count for worker-lifecycle
+    breadth so the state space stays ~1s."""
 
     name: str
     actions: tuple[str, ...]
     depth: int
+    cfg: ModelConfig | None = None
 
 
 SCENARIOS: tuple[Scenario, ...] = (
@@ -688,6 +793,15 @@ SCENARIOS: tuple[Scenario, ...] = (
     Scenario("fence", ("claim", "submit", "fail", "restart", "cancel",
                        "cancel_stale", "collect_stale", "collect",
                        "tick"), depth=9),
+    # elastic farm: the worker lifecycle driven against the lease
+    # protocol — drain/undrain/suspend/wake/rejoin interleaved with
+    # claims, results and the expiry sweep. Proves no shard is ever
+    # leased to a DRAINING/SUSPENDED worker and a drain never strands
+    # a lease (suspend only with an empty lease set).
+    Scenario("drain", ("claim", "submit", "tick", "sweep", "drain",
+                       "undrain", "suspend", "wake", "wake_fail",
+                       "rejoin", "hb"), depth=8,
+             cfg=ModelConfig(shards=2, t_max=3)),
 )
 
 
@@ -697,23 +811,29 @@ class ExploreResult:
     states: int
     violations: list[Violation]
     edges: set  # exercised (src, dst) shard edges
+    wedges: set = dataclasses.field(default_factory=set)
+    #: exercised (src, dst) worker-lifecycle edges
 
 
 def explore(scenario: Scenario, declared, cfg: ModelConfig | None = None,
             mutations: Iterable[str] = (),
-            stop_at_first: bool = True) -> ExploreResult:
+            stop_at_first: bool = True,
+            wdeclared=None) -> ExploreResult:
     """Deterministic BFS over the model under one scenario's action
     set. Checks every transition invariant and flags terminal states
     that strand open shards; BFS order makes the first counterexample
-    a shortest one."""
-    cfg = cfg or ModelConfig()
+    a shortest one. `wdeclared` is the worker-lifecycle table (None =
+    machine not declared; its checks stay dormant)."""
+    cfg = cfg if cfg is not None else (scenario.cfg or ModelConfig())
     model = BoardModel(cfg, mutations)
     declared = frozenset(declared)
+    wdeclared = frozenset(wdeclared) if wdeclared is not None else None
     init = _initial(cfg)
     parent: dict = {init: None}
     frontier = [init]
     depth = 0
     edges_seen: set = set()
+    wedges_seen: set = set()
     violations: list[Violation] = []
 
     def trace_of(state, action=None) -> tuple[str, ...]:
@@ -738,19 +858,24 @@ def explore(scenario: Scenario, declared, cfg: ModelConfig | None = None,
                                                 trace_of(state)))
                     if stop_at_first:
                         return ExploreResult(scenario.name, len(parent),
-                                             violations, edges_seen)
+                                             violations, edges_seen,
+                                             wedges_seen)
                 continue
             for action in acts:
                 post, edges, notes = model.apply(state, action)
                 edges_seen.update((a, b) for _i, a, b in edges)
+                wedges_seen.update(
+                    (a, b) for _w, a, b in notes.get("wedges", ()))
                 bad = _check_transition(state, action, post, edges,
-                                        notes, declared)
+                                        notes, declared,
+                                        wdeclared=wdeclared)
                 if bad is not None:
                     violations.append(Violation(
                         bad[0], bad[1], trace_of(state, action)))
                     if stop_at_first:
                         return ExploreResult(scenario.name, len(parent),
-                                             violations, edges_seen)
+                                             violations, edges_seen,
+                                             wedges_seen)
                     continue
                 if post not in parent:
                     if len(parent) >= cfg.max_states:
@@ -764,7 +889,7 @@ def explore(scenario: Scenario, declared, cfg: ModelConfig | None = None,
     # successors at the depth horizon ONLY when genuinely actionless —
     # handled above; frontier states at max depth are not terminal.
     return ExploreResult(scenario.name, len(parent), violations,
-                         edges_seen)
+                         edges_seen, wedges_seen)
 
 
 def _shard_machine(manifest: Manifest) -> StateMachine | None:
@@ -772,26 +897,48 @@ def _shard_machine(manifest: Manifest) -> StateMachine | None:
                  if m.name == "shard"), None)
 
 
+def _worker_machine(manifest: Manifest) -> StateMachine | None:
+    return next((m for m in manifest.state_machines
+                 if m.name == "worker"), None)
+
+
+def _explore_all(manifest: Manifest, cfg: ModelConfig | None,
+                 mutations: Iterable[str],
+                 scenarios: tuple[Scenario, ...]
+                 ) -> tuple[list[Violation], set, set]:
+    """Run every scenario; returns (violations, exercised shard edges,
+    exercised worker-lifecycle edges)."""
+    shard = _shard_machine(manifest)
+    if shard is None:
+        return [], set(), set()
+    worker = _worker_machine(manifest)
+    declared = frozenset(shard.transitions)
+    wdeclared = frozenset(worker.transitions) \
+        if worker is not None else None
+    all_violations: list[Violation] = []
+    exercised: set = set()
+    wexercised: set = set()
+    for sc in scenarios:
+        res = explore(sc, declared, cfg=cfg, mutations=mutations,
+                      wdeclared=wdeclared)
+        all_violations.extend(res.violations)
+        exercised |= res.edges
+        wexercised |= res.wedges
+        if all_violations:
+            break
+    return all_violations, exercised, wexercised
+
+
 def check_model(manifest: Manifest, cfg: ModelConfig | None = None,
                 mutations: Iterable[str] = (),
                 scenarios: tuple[Scenario, ...] = SCENARIOS
                 ) -> tuple[list[Violation], set]:
     """Run every scenario; returns (violations, union of exercised
-    edges). The shipped tree must come back ([], exactly the declared
-    table)."""
-    shard = _shard_machine(manifest)
-    if shard is None:
-        return [], set()
-    declared = frozenset(shard.transitions)
-    all_violations: list[Violation] = []
-    exercised: set = set()
-    for sc in scenarios:
-        res = explore(sc, declared, cfg=cfg, mutations=mutations)
-        all_violations.extend(res.violations)
-        exercised |= res.edges
-        if all_violations:
-            break
-    return all_violations, exercised
+    shard edges). The shipped tree must come back ([], exactly the
+    declared table)."""
+    violations, exercised, _w = _explore_all(manifest, cfg, mutations,
+                                             scenarios)
+    return violations, exercised
 
 
 def model_findings(manifest: Manifest,
@@ -799,7 +946,8 @@ def model_findings(manifest: Manifest,
     shard = _shard_machine(manifest)
     if shard is None:
         return []
-    violations, exercised = check_model(manifest, cfg=cfg)
+    violations, exercised, wexercised = _explore_all(
+        manifest, cfg, (), SCENARIOS)
     findings = [
         finding("TVT-M002", "", 0,
                 f"board model: {v.format()}",
@@ -815,6 +963,18 @@ def model_findings(manifest: Manifest,
                 f"shard transition table is stale: declared-but-never-"
                 f"exercised {missing}, exercised-but-undeclared {extra}",
                 key_detail="model:table-coverage"))
+        worker = _worker_machine(manifest)
+        if worker is not None:
+            wdeclared = set(worker.transitions)
+            wmissing = sorted(wdeclared - wexercised)
+            wextra = sorted(wexercised - wdeclared)
+            if wmissing or wextra:
+                findings.append(finding(
+                    "TVT-M002", "", 0,
+                    f"worker-lifecycle transition table is stale: "
+                    f"declared-but-never-exercised {wmissing}, "
+                    f"exercised-but-undeclared {wextra}",
+                    key_detail="model:worker-table-coverage"))
     return findings
 
 

@@ -150,6 +150,13 @@ class ApiServer:
         #: concurrent scrapes racing clear()-then-repopulate would
         #: render doubled or partial gauge values
         self._scrape_lock = threading.Lock()
+        #: chaos-harness fault injection (tools/loadgen.py --chaos):
+        #: while the monotonic clock is before this stamp, every
+        #: /work/* route answers 503 — the "partitioned /work routes"
+        #: failure the autoscale bench drives. Guarded by its own lock
+        #: (written by the chaos thread, read by every handler thread).
+        self._fault_lock = threading.Lock()
+        self._work_partition_until = 0.0
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -917,6 +924,12 @@ class ApiServer:
         qos = getattr(self.coordinator, "qos", None)
         if qos is not None:
             out["qos"] = qos.snapshot()
+        # elastic-farm lifecycle panel (farm/controller.py): per-host
+        # ACTIVE/DRAINING/SUSPENDED/WAKING plus the worker-seconds
+        # integral the autoscale bench reports
+        farm = getattr(self.coordinator, "farm", None)
+        if farm is not None:
+            out["farm"] = farm.snapshot()
         return 200, out
 
     def _h_metrics(self, query, body) -> tuple[int, Any]:
@@ -935,10 +948,28 @@ class ApiServer:
         with self._scrape_lock:
             jobs = obs_metrics.JOBS_BY_STATUS
             jobs.clear()
+            # the default tenant's full status schema is always
+            # present so a fresh scrape sees every series name; other
+            # tenants' series appear as their jobs do
             for status in Status:
-                jobs.labels(status.value).set(0)
+                jobs.labels("default", status.value).set(0)
             for job in self.coordinator.store.list():
-                jobs.labels(job.status.value).inc()
+                jobs.labels(getattr(job, "tenant", "default")
+                            or "default", job.status.value).inc()
+            tenant_shards = obs_metrics.TENANT_ACTIVE_SHARDS
+            tenant_shards.clear()
+            tenant_shards.labels("default").set(0)
+            if self.work is not None:
+                for tenant, n in self.work.tenant_assigned().items():
+                    tenant_shards.labels(tenant).set(n)
+            farm_workers = obs_metrics.FARM_WORKERS
+            farm_workers.clear()
+            farm = getattr(self.coordinator, "farm", None)
+            farm_counts = farm.snapshot()["counts"] if farm is not None \
+                else {}
+            for state in ("active", "draining", "suspended", "waking"):
+                farm_workers.labels(state).set(
+                    farm_counts.get(state, 0))
             sessions = obs_metrics.SESSIONS
             sessions.clear()
             for job_id, n in self.origin.sessions.concurrent().items():
@@ -969,10 +1000,24 @@ class ApiServer:
 
     # -- worker pull API (cluster/remote.py ShardBoard) ----------------
 
+    def partition_work(self, seconds: float) -> None:
+        """Black-hole the /work/* routes for `seconds` (chaos: the
+        network partition between coordinator and farm). Workers see
+        claim failures and back off exactly as they would against a
+        real partition; leases ride it out or expire into the sweep."""
+        with self._fault_lock:
+            self._work_partition_until = time.monotonic() + max(
+                0.0, float(seconds))
+
     def _work_board_or_503(self):
         if self.work is None:
             raise ApiError(503, "no remote work backend "
                                 "(execution_backend != remote)")
+        with self._fault_lock:
+            partitioned = time.monotonic() < self._work_partition_until
+        if partitioned:
+            raise ApiError(503, "work routes partitioned (chaos)",
+                           headers={"Retry-After": "1"})
         return self.work
 
     def _h_work_claim(self, query, body) -> tuple[int, Any]:

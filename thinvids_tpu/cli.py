@@ -43,13 +43,24 @@ def run_coordinator(args: argparse.Namespace) -> None:
     co = Coordinator(state_dir=state_dir)
     backend = str(getattr(args, "backend", "") or
                   get_settings().execution_backend)
+    farm = None
     if backend == "remote":
         from .cluster.remote import RemoteExecutor
+        from .farm import CapacityController, NullProvider
 
         execu = RemoteExecutor(co, args.output_dir, sync=False)
         work = execu.board
         log.info("remote execution backend: encode shards dispatch to "
                  "worker daemons via /work")
+        # elastic-farm capacity controller: lifecycle bookkeeping + the
+        # claim gate always run; wake/drain/suspend decisions engage
+        # when autoscale_enabled is set. The NullProvider only LOGS
+        # wake/suspend intent — wire a real provider (cloud API, WoL)
+        # per deploy/README.md.
+        farm = CapacityController(co, provider=NullProvider(),
+                                  board=execu.board)
+        co.farm = farm
+        farm.start()
     else:
         execu = LocalExecutor(co, args.output_dir, sync=False)
         work = None
@@ -112,6 +123,8 @@ def run_coordinator(args: argparse.Namespace) -> None:
     def shutdown(*_sig) -> None:
         stop.set()
         co.stop_background()
+        if farm is not None:
+            farm.stop()
         agent.stop()
         api.stop()
         # let in-flight encodes finish before the journal closes — a

@@ -107,6 +107,7 @@ class NodeAgent:
                  host: str | None = None, interval_s: float = 1.0,
                  settings_fn=None, idle_probe: Callable[[], bool] = None,
                  suspend_action: Callable[[], None] | None = None,
+                 resume_action: Callable[[], None] | None = None,
                  extra_metrics: Callable[[], Mapping[str, Any]] | None = None,
                  clock: Callable[[], float] = time.time) -> None:
         from ..core.config import get_settings
@@ -121,6 +122,12 @@ class NodeAgent:
         self._settings_fn = settings_fn or get_settings
         self._idle_probe = idle_probe or (lambda: False)
         self._suspend_action = suspend_action
+        #: inverse of suspend_action (the reference's WoL wake from
+        #: the node's own point of view): fires ONCE when a suspended
+        #: episode ends — work arrived, the operator toggled
+        #: suspend_enabled off mid-episode, or resume() was called
+        #: explicitly (the capacity controller's wake path)
+        self._resume_action = resume_action
         self._clock = clock
         self._idle_since: float | None = None
         self._suspended_this_episode = False
@@ -166,15 +173,21 @@ class NodeAgent:
             idle = cpu_ok and self._idle_probe()
         now = self._clock()
         fire = False
+        resume = False
         with self._gate_lock:
             if not idle:
+                # episode over — work arrived OR suspend_enabled was
+                # toggled off mid-episode. Either way the gate RE-ARMS
+                # (fresh idle window next time), and a suspended
+                # episode ends CLEANLY: resume_action fires once, the
+                # inverse the idle gate never had.
+                resume = self._suspended_this_episode \
+                    and self._resume_action is not None
                 self._idle_since = None
                 self._suspended_this_episode = False
-                return
-            if self._idle_since is None:
+            elif self._idle_since is None:
                 self._idle_since = now
-                return
-            if (now - self._idle_since
+            elif (now - self._idle_since
                     >= float(snap.get("suspend_idle_s", 300))
                     and not self._suspended_this_episode
                     and self._suspend_action is not None):
@@ -184,6 +197,34 @@ class NodeAgent:
             # outside the lock: the action may suspend the host —
             # holding the gate across it would stall a concurrent tick
             self._suspend_action()
+        if resume:
+            self._resume_action()
+
+    # -- episode state (the capacity controller's poll seam) -----------
+
+    def episode_state(self) -> dict[str, Any]:
+        """Point-in-time idle-episode facts: whether this agent's
+        suspend_action has fired for the current episode, and since
+        when the node has been idle. The capacity controller (or any
+        manager) polls this instead of guessing from metrics."""
+        with self._gate_lock:
+            return {"suspended": self._suspended_this_episode,
+                    "idle_since": self._idle_since}
+
+    def resume(self) -> bool:
+        """Explicitly end a suspended episode (the controller's wake
+        path, or an operator kick): fires resume_action once and
+        re-arms the idle gate. Returns True when an episode actually
+        ended; False when nothing was suspended."""
+        with self._gate_lock:
+            if not self._suspended_this_episode:
+                return False
+            self._suspended_this_episode = False
+            self._idle_since = None
+            action = self._resume_action
+        if action is not None:
+            action()
+        return True
 
     # -- loop ----------------------------------------------------------
 

@@ -177,6 +177,11 @@ class Coordinator:
         #: (cluster/qos.py). Executors report live part latency here;
         #: the ShardBoard and local wave loops read the batch gate.
         self.qos = QosController()
+        #: elastic-farm capacity controller (farm/controller.py),
+        #: attached by cli.py when the remote backend runs: the
+        #: ShardBoard consults it so DRAINING/SUSPENDED workers never
+        #: claim. None = fixed-size farm (every worker claims).
+        self.farm = None
 
     # ---- job registration / lifecycle --------------------------------
 
@@ -209,9 +214,16 @@ class Coordinator:
                                or "transcode")
         if job_type not in ("transcode", "ladder", "live"):
             raise ValueError(f"unknown job_type {job_type!r}")
+        # tenant namespace (farm/tenancy.py): per-job setting > the
+        # <tenant>__name filename prefix > the cluster default
+        from ..farm.tenancy import tenant_of
+
+        tenant = tenant_of(
+            input_path,
+            (settings or {}).get("tenant") or snap.get("tenant", ""))
         decision = evaluate_job_policy(meta, snap)
         job = self.store.create(input_path, meta=meta, settings=settings,
-                                job_type=job_type)
+                                job_type=job_type, tenant=tenant)
         if not decision.accepted:
             def reject(j: Job) -> None:
                 # freshly created above, so READY is the only possible
@@ -428,11 +440,13 @@ class Coordinator:
             obs_trace.TRACE.record_error(
                 job_id, f"qos breach: live part {latency_s:.2f}s over "
                         f"{budget_s:.2f}s budget")
+            breached = self.store.try_get(job_id)
             obs_flight.record(
                 job_id, reason=f"qos preemption: live part "
                                f"{latency_s:.2f}s over {budget_s:.2f}s "
                                f"budget",
-                settings=self._settings_fn())
+                settings=self._settings_fn(),
+                tenant=getattr(breached, "tenant", ""))
         elif event == "recovered":
             self.activity.emit(
                 "qos", "live edge recovered — batch work resumes",
@@ -523,9 +537,11 @@ class Coordinator:
         # settings dump beside the output tree so the postmortem does
         # not depend on scraping logs (obs/flight.py; best-effort)
         obs_trace.TRACE.record_error(job_id, f"{stage}: {reason}")
+        failed = self.store.try_get(job_id)
         obs_flight.record(job_id,
                           reason=f"job failed in {stage}: {reason}",
-                          settings=self._settings_fn())
+                          settings=self._settings_fn(),
+                          tenant=getattr(failed, "tenant", ""))
 
     # ---- scheduler (capacity-gated dispatch) -------------------------
 
@@ -614,18 +630,32 @@ class Coordinator:
     def dispatch_next_waiting_job(self) -> Job | None:
         """One scheduler pass: reserve the best WAITING job — highest
         priority class first (live > ladder > batch, cluster/qos.py),
-        oldest within a class — when its class's admission gate
-        passes, then launch it outside the lock
+        most-underserved tenant next (weighted fair share,
+        farm/tenancy.py: active-job count ÷ the tenant's
+        `tenant_shares` weight — one tenant's backlog cannot starve
+        another's first job), oldest within that — when its class's
+        admission gate passes, then launch it outside the lock
         (/root/reference/manager/app.py:1296-1310)."""
+        from ..farm.tenancy import fair_usage, parse_tenant_shares
+
         now = self._clock()
         snap = self._settings_fn()
+        shares = parse_tenant_shares(snap.get("tenant_shares", ""))
         with self._sched_lock:
             active = self._active_jobs_locked()
             waiting = self.store.list(Status.WAITING)
+            usage: dict[str, float] = {}
+            for j in active:
+                t = getattr(j, "tenant", "default") or "default"
+                usage[t] = usage.get(t, 0.0) + 1.0
             job = None
             while waiting:
                 chosen = min(waiting, key=lambda j: (
-                    self._job_rank(j, snap), j.queued_at or j.created_at))
+                    self._job_rank(j, snap),
+                    fair_usage(shares, usage,
+                               getattr(j, "tenant", "default")
+                               or "default"),
+                    j.queued_at or j.created_at))
                 ok, _why = self._can_dispatch_locked(
                     active, snap, now, rank=self._job_rank(chosen, snap))
                 if not ok:

@@ -44,6 +44,11 @@ class Job:
     job_type: str = "transcode"
     # settings overlay (core.config.JOB_SETTING_KEYS subset)
     settings: dict[str, Any] = dataclasses.field(default_factory=dict)
+    # tenant namespace (farm/tenancy.py): resolved at registration
+    # from the per-job `tenant` setting, the `<tenant>__name` filename
+    # prefix, or the cluster default — the fair-share admission key
+    # and the per-tenant metrics label
+    tenant: str = "default"
     # admission decision (policy.py): the remote backend encodes
     # "direct" jobs whole on the coordinator mesh instead of farming
     # split shards (cluster/remote.py)
@@ -251,10 +256,11 @@ class JobStore:
     def create(self, input_path: str, meta: VideoMeta | None = None,
                settings: Mapping[str, Any] | None = None,
                job_id: str | None = None,
-               job_type: str = "transcode") -> Job:
+               job_type: str = "transcode",
+               tenant: str = "default") -> Job:
         job = Job(id=job_id or uuid.uuid4().hex, input_path=input_path,
                   meta=meta, settings=dict(settings or {}),
-                  job_type=job_type)
+                  job_type=job_type, tenant=tenant)
         with self._lock:
             if job.id in self._jobs:
                 raise ValueError(f"duplicate job id {job.id}")

@@ -154,6 +154,11 @@ class Shard:
     # claims hand out the best class first, and batch-rank shards are
     # requeued/eligibility-gated while a live job is over deadline
     priority: int = 2
+    # tenant namespace (farm/tenancy.py): within a priority class the
+    # claim picks the most-underserved tenant first (weighted fair
+    # share over currently-ASSIGNED shards), so one tenant's backlog
+    # cannot monopolize the farm
+    tenant: str = "default"
     # distributed-trace context (obs/trace): the job's trace id rides
     # the claim descriptor to the worker, which echoes it back in the
     # X-Tvt-Trace header on its /work uploads — a farm job's worker
@@ -322,26 +327,37 @@ class ShardBoard:
     # -- worker-facing API (via api/server.py /work/* routes) ----------
 
     def _worker_eligible_locked(self, host: str, now: float) -> bool:
-        """Placement gate: quarantined workers never claim; the
-        pipeline/encode role split governs who encodes — an encode-role
-        worker always claims, a pipeline-role worker is held in reserve
-        for the pipeline stages and claims only OVERFLOW: when no live
-        encode-role host is a claim-capable worker, or when more shards
-        are pending than live encode workers can start on (reserving it
-        would just idle the farm). Daemons self-identify with ``worker:
-        true`` in their heartbeat metrics; metrics-only agents and the
-        coordinator's device pseudo-hosts can hold the encode role but
-        can't take work, and must not starve the farm."""
+        """Placement gate: quarantined AND stale workers never claim
+        (liveness is re-checked HERE, under the lock, from the
+        registry's current state — a worker whose heartbeat TTL lapsed
+        used to be able to win a shard in a race against
+        ``requeue_expired``'s pre-lock active-set snapshot, which then
+        immediately swept the fresh lease and burned an attempt); the
+        elastic-farm lifecycle gate refuses DRAINING/SUSPENDED workers
+        outright (farm/controller.py — the model-checked invariant);
+        then the pipeline/encode role split governs who encodes — an
+        encode-role worker always claims, a pipeline-role worker is
+        held in reserve for the pipeline stages and claims only
+        OVERFLOW: when no live encode-role host is a claim-capable
+        worker, or when more shards are pending than live encode
+        workers can start on (reserving it would just idle the farm).
+        Daemons self-identify with ``worker: true`` in their heartbeat
+        metrics; metrics-only agents and the coordinator's device
+        pseudo-hosts can hold the encode role but can't take work, and
+        must not starve the farm."""
         reg = self.coordinator.registry
         snap = self.coordinator._settings_fn()
+        ttl = float(snap.metrics_ttl_s)
         reg.assign_roles(int(snap.pipeline_worker_count))
         workers = {w.host: w for w in reg.all()}
         me = workers.get(host)
-        if me is None or me.disabled:
+        if me is None or me.disabled or now - me.last_seen > ttl:
+            return False
+        farm = getattr(self.coordinator, "farm", None)
+        if farm is not None and not farm.claim_allowed(host):
             return False
         if me.role == "encode":
             return True
-        ttl = float(snap.metrics_ttl_s)
         active = reg.active(ttl, now=now)
         encode_workers = sum(1 for w in active
                              if w.role == "encode" and w.metrics.get("worker"))
@@ -363,24 +379,37 @@ class ShardBoard:
 
     def claim(self, host: str) -> dict[str, Any] | None:
         """Lease the best eligible PENDING shard to `host` — highest
-        QoS class first (live > ladder > batch), oldest within a
-        class; batch-rank shards are withheld entirely while a live
+        QoS class first (live > ladder > batch), most-underserved
+        tenant within a class (weighted fair share over the tenants'
+        currently-ASSIGNED shards, farm/tenancy.py), oldest within
+        that; batch-rank shards are withheld entirely while a live
         job is over its deadline. None when no work (or the host may
-        not take any). A claim doubles as a liveness heartbeat — a
-        worker that can ask for work is alive."""
+        not take any). A GRANTED claim doubles as a liveness
+        heartbeat — a worker that demonstrably encoded its way here is
+        alive — but an idle poll does not: a worker whose agent
+        heartbeat lapsed cannot win work merely by asking (the
+        eligibility gate re-checks the TTL under the lock)."""
+        from ..farm.tenancy import fair_usage, parse_tenant_shares
         from .qos import BATCH_RANK
 
         host = (host or "").strip()
         if not host:
             return None
         now = self._clock()
-        self.coordinator.registry.heartbeat(host, now=now)
+        granted: dict[str, Any] | None = None
         with self._lock:
             if not self._worker_eligible_locked(host, now):
                 return None
             batch_gated = self._batch_gated_locked()
+            shares = parse_tenant_shares(
+                self.coordinator._settings_fn().get("tenant_shares", ""))
+            usage: dict[str, float] = {}
+            for entry in self._jobs.values():
+                for s in entry.shards.values():
+                    if s.state is ShardState.ASSIGNED:
+                        usage[s.tenant] = usage.get(s.tenant, 0.0) + 1.0
             best: Shard | None = None
-            best_key: tuple[int, int] | None = None
+            best_key: tuple[int, float, int] | None = None
             for pos, sid in enumerate(self._order):
                 shard = self._find_locked(sid)
                 if (shard is None or shard.state is not ShardState.PENDING
@@ -388,7 +417,8 @@ class ShardBoard:
                     continue
                 if batch_gated and shard.priority >= BATCH_RANK:
                     continue
-                key = (shard.priority, pos)
+                key = (shard.priority,
+                       fair_usage(shares, usage, shard.tenant), pos)
                 if best_key is None or key < best_key:
                     best, best_key = shard, key
             if best is not None and best.state is ShardState.PENDING:
@@ -400,8 +430,13 @@ class ShardBoard:
                 best.assigned_host = host
                 best.assigned_at = now
                 best.deadline_at = now + best.timeout_s
-                return best.descriptor()
-        return None
+                granted = best.descriptor()
+                # grant-heartbeat INSIDE the lock: the lease and the
+                # liveness refresh commit atomically w.r.t. the sweep
+                # (which reads the registry under this same lock), so
+                # a fresh lease can never look orphaned
+                self.coordinator.registry.heartbeat(host, now=now)
+        return granted
 
     def submit_part(self, shard_id: str, host: str,
                     segments: list[EncodedSegment]) -> bool:
@@ -477,6 +512,7 @@ class ShardBoard:
                 shard.not_before = now + entry.backoff_s \
                     * (2 ** (shard.attempt - 1))
             job_id = shard.job_id
+            shard_tenant = shard.tenant
             quarantine_after = entry.quarantine_after
             # capture under the lock: a concurrent claim can flip the
             # shard back to ASSIGNED before the emit below runs, which
@@ -509,18 +545,23 @@ class ShardBoard:
                     job_id,
                     reason=f"worker {host} quarantined after {streak} "
                            f"consecutive shard failures",
-                    settings=self.coordinator._settings_fn())
+                    settings=self.coordinator._settings_fn(),
+                    tenant=shard_tenant)
 
     def requeue_expired(self) -> list[str]:
         """Lease sweep: requeue ASSIGNED shards whose deadline passed or
         whose worker's heartbeat went stale (killed mid-shard). Returns
-        the requeued/failed shard ids."""
+        the requeued/failed shard ids. The active set is computed
+        UNDER the board lock so a lease granted concurrently (claims
+        heartbeat on grant before releasing their `now`) can never be
+        judged against a staler snapshot than the one that granted
+        it."""
         now = self._clock()
         snap = self.coordinator._settings_fn()
-        active = {w.host for w in self.coordinator.registry.active(
-            float(snap.metrics_ttl_s), now=now)}
         expired: list[tuple[str, str, str]] = []
         with self._lock:
+            active = {w.host for w in self.coordinator.registry.active(
+                float(snap.metrics_ttl_s), now=now)}
             for entry in self._jobs.values():
                 for shard in entry.shards.values():
                     if shard.state is not ShardState.ASSIGNED:
@@ -536,22 +577,20 @@ class ShardBoard:
             self.report_failure(sid, host, why)
         return [sid for sid, _h, _w in expired]
 
-    def preempt_batch(self) -> int:
-        """QoS preemption (cluster/qos.py): requeue every ASSIGNED
-        batch-rank shard so its worker frees up for the struggling
-        live edge. NOT a failure — no attempt is burned, no backoff,
-        no quarantine accounting; the preempted worker's late part is
-        still accepted while the shard is open (first result wins,
-        deterministic encode), so no work is wasted either. Returns
-        how many shards were requeued."""
-        from .qos import BATCH_RANK
-
+    def _preempt_where(self, keep_assigned) -> list[tuple[str, str]]:
+        """Shared preemption body: requeue every ASSIGNED shard for
+        which `keep_assigned(shard)` is False. NOT a failure — no
+        attempt is burned, no backoff, no quarantine accounting; the
+        evicted worker's late part is still accepted while the shard
+        is open (first result wins, deterministic encode), so no work
+        is wasted either. Counted in the snapshot's `preempted`
+        figure. Returns the (shard id, evicted host) pairs."""
         requeued: list[tuple[str, str]] = []
         with self._lock:
             for entry in self._jobs.values():
                 for shard in entry.shards.values():
                     if shard.state is not ShardState.ASSIGNED \
-                            or shard.priority < BATCH_RANK:
+                            or keep_assigned(shard):
                         continue
                     shard.state = ShardState.PENDING
                     host = shard.assigned_host
@@ -559,12 +598,88 @@ class ShardBoard:
                     shard.not_before = 0.0
                     requeued.append((shard.id, host))
                     self._preempted += 1
+        return requeued
+
+    def preempt_batch(self) -> int:
+        """QoS preemption (cluster/qos.py): requeue every ASSIGNED
+        batch-rank shard so its worker frees up for the struggling
+        live edge. Returns how many shards were requeued."""
+        from .qos import BATCH_RANK
+
+        requeued = self._preempt_where(
+            lambda s: s.priority < BATCH_RANK)
         for sid, host in requeued:
             self.coordinator.activity.emit(
                 "qos-preempt",
                 f"batch shard {sid} requeued off {host or 'unknown'} "
                 f"(live deadline breach)", host=host)
         return len(requeued)
+
+    def preempt_host(self, host: str) -> int:
+        """Requeue every shard ASSIGNED to `host` — the elastic farm's
+        drain-grace escape hatch (farm/controller.py): a DRAINING
+        worker stuck past `drain_grace_s` has its leases handed back
+        with the same preemption semantics as the QoS path (shared
+        body above). Returns how many leases were requeued."""
+        requeued = self._preempt_where(
+            lambda s: s.assigned_host != host)
+        for sid, _h in requeued:
+            self.coordinator.activity.emit(
+                "farm", f"shard {sid} requeued off draining worker "
+                f"{host}", host=host)
+        return len(requeued)
+
+    # alias the controller calls by intent (drain-grace requeue)
+    requeue_host = preempt_host
+
+    def host_leases(self, host: str) -> int:
+        """ASSIGNED shards currently leased to `host` — the drain
+        controller's single-host is-it-empty-yet re-check."""
+        with self._lock:
+            return sum(
+                1 for entry in self._jobs.values()
+                for s in entry.shards.values()
+                if s.state is ShardState.ASSIGNED
+                and s.assigned_host == host)
+
+    def host_lease_counts(self) -> dict[str, int]:
+        """ASSIGNED shards per host in ONE locked pass — the capacity
+        controller's per-tick observation (per-host host_leases calls
+        would take the board lock once per worker)."""
+        out: dict[str, int] = {}
+        with self._lock:
+            for entry in self._jobs.values():
+                for s in entry.shards.values():
+                    if s.state is ShardState.ASSIGNED:
+                        out[s.assigned_host] = \
+                            out.get(s.assigned_host, 0) + 1
+        return out
+
+    def queue_depth(self, now: float | None = None) -> dict[int, int]:
+        """Claimable PENDING shards by QoS rank — the capacity
+        controller's demand input (backoff-gated shards excluded: they
+        are not claimable THIS instant, and counting them would make
+        the farm chase retries)."""
+        now = self._clock() if now is None else now
+        depth: dict[int, int] = {}
+        with self._lock:
+            for entry in self._jobs.values():
+                for s in entry.shards.values():
+                    if s.state is ShardState.PENDING \
+                            and now >= s.not_before:
+                        depth[s.priority] = depth.get(s.priority, 0) + 1
+        return depth
+
+    def tenant_assigned(self) -> dict[str, int]:
+        """Currently-ASSIGNED shards per tenant — the
+        `tvt_tenant_active_shards` gauge's scrape-time source."""
+        out: dict[str, int] = {}
+        with self._lock:
+            for entry in self._jobs.values():
+                for s in entry.shards.values():
+                    if s.state is ShardState.ASSIGNED:
+                        out[s.tenant] = out.get(s.tenant, 0) + 1
+        return out
 
     def _find_locked(self, shard_id: str) -> Shard | None:
         for entry in self._jobs.values():
@@ -581,12 +696,16 @@ class ShardBoard:
         with self._lock:
             counts = {s.value: 0 for s in ShardState}
             per_job: dict[str, dict[str, int]] = {}
+            tenants: dict[str, dict[str, int]] = {}
             for job_id, entry in self._jobs.items():
                 jc = per_job.setdefault(job_id, dict.fromkeys(
                     (s.value for s in ShardState), 0))
                 for shard in entry.shards.values():
                     counts[shard.state.value] += 1
                     jc[shard.state.value] += 1
+                    tc = tenants.setdefault(shard.tenant, dict.fromkeys(
+                        (s.value for s in ShardState), 0))
+                    tc[shard.state.value] += 1
             recent = list(self._recent)
             preempted = self._preempted
         workers = {}
@@ -603,7 +722,8 @@ class ShardBoard:
                 "shards_done": 0, "shards_failed": 0, "quarantined": False})
             stats.setdefault("last_shard_s", rec["elapsed_s"])
         return {"shards": counts, "jobs": per_job, "workers": workers,
-                "recent": recent[-20:], "preempted": preempted}
+                "tenants": tenants, "recent": recent[-20:],
+                "preempted": preempted}
 
 
 class RemoteExecutor(LocalExecutor):
@@ -712,7 +832,8 @@ class RemoteExecutor(LocalExecutor):
                 rung=rung.name if rung is not None else "",
                 rung_width=rung.width if rung is not None else 0,
                 rung_height=rung.height if rung is not None else 0,
-                priority=priority, trace_id=trace_id))
+                priority=priority, trace_id=trace_id,
+                tenant=getattr(job, "tenant", "default") or "default"))
         return shards
 
     def _build_shards(self, job: Job, meta, num_frames: int,

@@ -164,6 +164,34 @@ DEFAULT_SETTINGS: dict[str, Any] = {
     "suspend_enabled": False,
     "suspend_idle_s": 300.0,
     "suspend_cpu_pct": 20.0,
+    # elastic farm (farm/controller.py): autoscale_enabled gates the
+    # CapacityController's wake/drain/suspend decisions
+    # (TVT_AUTOSCALE_ENABLED; lifecycle bookkeeping and the claim gate
+    # run regardless); farm_min_workers / farm_max_workers bound the
+    # ACTIVE worker count (max 0 = no cap — scale to whatever demand
+    # asks for); drain_grace_s is the lifecycle grace: a DRAINING
+    # worker still holding leases past it has them requeued (no
+    # attempt burn) before suspend, and a WAKING worker with no
+    # heartbeat inside it falls back to SUSPENDED for a retry.
+    "autoscale_enabled": False,
+    "farm_min_workers": 0,
+    "farm_max_workers": 0,
+    "drain_grace_s": 30.0,
+    # multi-tenant fair share (farm/tenancy.py): tenant is the per-job
+    # namespace override (TVT_TENANT as a cluster default; normally
+    # set per job or via the <tenant>__name filename prefix);
+    # tenant_shares weights the fair-share admission ("acme:3,bravo:1"
+    # — unlisted tenants weigh 1) at BOTH admission points: the
+    # dispatch pass and the shard board's claim.
+    "tenant": "",
+    "tenant_shares": "",
+    # chaos harness (tools/loadgen.py --chaos + bench _run_autoscale):
+    # mean seconds between worker SIGKILLs (0 = no kills), the /work
+    # route partition length (0 = no partition), and the diurnal load
+    # curve's period.
+    "chaos_kill_interval_s": 0.0,
+    "chaos_partition_s": 0.0,
+    "chaos_period_s": 60.0,
     # remote worker execution backend (cluster/remote.py)
     "execution_backend": "local",    # local | remote
     "remote_shard_gops": 0,          # GOPs per shard; 0 = auto (~2/worker)
@@ -296,7 +324,37 @@ _CLAMPS: dict[str, Callable[[Any], Any]] = {
     # floor: a non-positive poll would busy-spin idle workers against
     # the coordinator's /work/claim
     "remote_claim_poll_s": lambda v: max(0.05, as_float(v, 1.0)),
+    "farm_min_workers": lambda v: min(4096, max(0, as_int(v, 0))),
+    "farm_max_workers": lambda v: min(4096, max(0, as_int(v, 0))),
+    # floor keeps a drain from force-requeueing leases the instant it
+    # starts; cap bounds how long a stuck drain can pin a host
+    "drain_grace_s": lambda v: min(3600.0, max(1.0, as_float(v, 30.0))),
+    # tenant labels sanitize through the one canonical cleaner
+    # (farm/tenancy.py) so the config tier, the filename parser and
+    # the scheduler all agree on the namespace; "" stays "" (= derive
+    # from the job name)
+    "tenant": lambda v: _clean_tenant_setting(v),
+    "tenant_shares": lambda v: _clean_tenant_shares(v),
+    "chaos_kill_interval_s": lambda v: min(
+        3600.0, max(0.0, as_float(v, 0.0))),
+    "chaos_partition_s": lambda v: min(
+        600.0, max(0.0, as_float(v, 0.0))),
+    "chaos_period_s": lambda v: min(
+        86400.0, max(1.0, as_float(v, 60.0))),
 }
+
+
+def _clean_tenant_setting(raw: Any) -> str:
+    from ..farm.tenancy import clean_tenant
+
+    text = str(raw or "").strip()
+    return clean_tenant(text) if text else ""
+
+
+def _clean_tenant_shares(raw: Any) -> str:
+    from ..farm.tenancy import render_tenant_shares
+
+    return render_tenant_shares(raw)
 
 
 def _validate_setting(key: str, raw: Any) -> Any:
@@ -413,7 +471,7 @@ JOB_SETTING_KEYS = frozenset(
     {"gop_frames", "qp", "rc_mode", "target_bitrate_kbps",
      "max_segments", "profile_dir", "ladder_rungs", "segment_s",
      "live_stall_s", "dvr_window_s", "job_priority",
-     "live_part_budget_s", "sfe_bands", "sfe_halo_rows"}
+     "live_part_budget_s", "sfe_bands", "sfe_halo_rows", "tenant"}
 )
 
 

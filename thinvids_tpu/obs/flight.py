@@ -56,9 +56,12 @@ def configured_dir() -> str:
 
 
 def record(job_id: str, reason: str, out_dir: str | None = None,
-           settings: Mapping[str, Any] | None = None) -> str | None:
+           settings: Mapping[str, Any] | None = None,
+           tenant: str = "") -> str | None:
     """Dump the job's flight record. Returns the artifact path, or
-    None when disabled, unconfigured, or nothing was ever traced."""
+    None when disabled, unconfigured, or nothing was ever traced.
+    `tenant` rides next to the settings snapshot so a multi-tenant
+    postmortem attributes the incident without a store lookup."""
     snap = get_settings()
     if not as_bool(snap.get("flight_record", True), True):
         return None
@@ -76,8 +79,15 @@ def record(job_id: str, reason: str, out_dir: str | None = None,
     other = dict(doc.get("otherData") or {})
     other["reason"] = str(reason)
     other["recorded_at"] = time.time()
+    if tenant:
+        other["tenant"] = str(tenant)
     if settings is not None:
-        values = getattr(settings, "values", settings)
+        # Settings snapshots carry their mapping as `.values` (a
+        # FIELD); on a plain dict that name is the bound values()
+        # METHOD — use the dict itself then
+        values = getattr(settings, "values", None)
+        if values is None or callable(values):
+            values = settings
         other["settings"] = {k: v for k, v in dict(values).items()}
     doc["otherData"] = other
     path = os.path.join(out_dir, f"{job_id}.trace.json")

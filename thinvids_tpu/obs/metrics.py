@@ -381,9 +381,23 @@ SFE_FRAME_SECONDS = REGISTRY.histogram(
     "steady-state gap between consecutive SFE frames' "
     "bitstream-ready times")
 
-# -- job control plane --------------------------------------------------
+# -- job control plane / multi-tenant farm ------------------------------
 JOBS_BY_STATUS = REGISTRY.gauge(
-    "tvt_jobs", "registered jobs by status", labels=("status",))
+    "tvt_jobs", "registered jobs by tenant and status",
+    labels=("tenant", "status"))
+TENANT_ACTIVE_SHARDS = REGISTRY.gauge(
+    "tvt_tenant_active_shards",
+    "shards currently ASSIGNED on the remote work board, per tenant",
+    labels=("tenant",))
+FARM_WORKERS = REGISTRY.gauge(
+    "tvt_farm_workers",
+    "elastic-farm worker hosts by lifecycle state "
+    "(farm/controller.py)",
+    labels=("lifecycle",))
+FARM_WORKER_SECONDS = REGISTRY.counter(
+    "tvt_farm_active_worker_seconds_total",
+    "cumulative non-SUSPENDED worker-seconds the farm consumed — the "
+    "energy-proportionality figure vs. always-on")
 
 
 def percentiles(sorted_values: list[float],

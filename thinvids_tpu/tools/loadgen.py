@@ -215,6 +215,92 @@ class PlayerSession:
                 time.sleep(0.1)
 
 
+# ---------------------------------------------------------------------------
+# chaos mode (--chaos): diurnal encode demand + injected failures
+# ---------------------------------------------------------------------------
+
+
+def chaos_defaults(snap=None) -> dict:
+    """The chaos knobs' settings tier (TVT_CHAOS_*): mean seconds
+    between worker kills (0 = none), /work partition length (0 =
+    none), and the diurnal curve period. One reader for every harness
+    (this CLI's --chaos mode and bench.py's _run_autoscale)."""
+    from ..core.config import get_settings
+
+    snap = snap if snap is not None else get_settings()
+    return {
+        "kill_interval_s": float(snap.get("chaos_kill_interval_s",
+                                          0.0)),
+        "partition_s": float(snap.get("chaos_partition_s", 0.0)),
+        "period_s": float(snap.get("chaos_period_s", 60.0)),
+    }
+
+
+def diurnal_rate(t_s: float, period_s: float, lo_rps: float,
+                 hi_rps: float) -> float:
+    """Sinusoidal day curve: submission rate at time `t_s` into the
+    run, peaking at hi_rps mid-period and bottoming at lo_rps at the
+    start/end — one compressed diurnal cycle per `period_s`. The
+    autoscale bench drives job arrivals with this so the farm has a
+    real trough to scale down into."""
+    import math
+
+    phase = (t_s % max(1e-9, period_s)) / max(1e-9, period_s)
+    # -cos: starts at the trough, peaks at phase 0.5, returns
+    return lo_rps + (hi_rps - lo_rps) * 0.5 * (
+        1.0 - math.cos(2.0 * math.pi * phase))
+
+
+def run_chaos_load(submit, duration_s: float, *, period_s: float = 60.0,
+                   lo_rps: float = 0.0, hi_rps: float = 1.0,
+                   kill=None, kill_interval_s: float = 0.0,
+                   partition=None, partition_s: float = 0.0,
+                   clock=None, sleep=None) -> dict:
+    """Drive a diurnal submission curve with chaos injected (the farm
+    proving ground the ROADMAP item asks for): `submit(i)` registers
+    the i-th job; `kill()` (fired every `kill_interval_s`, when given)
+    SIGKILLs a worker; `partition(seconds)` (fired once, mid-run at
+    the curve's peak, when given) black-holes the /work routes.
+    `clock`/`sleep` are injectable for deterministic tests. Returns
+    submission/chaos-event counts plus the curve parameters so the
+    bench pins its context."""
+    import time as _time
+
+    clock = clock or _time.monotonic
+    sleep = sleep or _time.sleep
+    t0 = clock()
+    submitted = kills = partitions = 0
+    next_kill = kill_interval_s if kill_interval_s > 0 else None
+    partition_at = 0.5 * period_s if partition is not None \
+        and partition_s > 0 else None
+    credit = 0.0
+    last = t0
+    while True:
+        now = clock()
+        t = now - t0
+        if t >= duration_s:
+            break
+        # integrate the rate curve into whole submissions
+        credit += diurnal_rate(t, period_s, lo_rps, hi_rps) * (now - last)
+        last = now
+        while credit >= 1.0:
+            credit -= 1.0
+            submit(submitted)
+            submitted += 1
+        if next_kill is not None and t >= next_kill and kill is not None:
+            if kill():
+                kills += 1
+            next_kill += kill_interval_s
+        if partition_at is not None and t >= partition_at:
+            partition(partition_s)
+            partitions += 1
+            partition_at = None
+        sleep(0.05)
+    return {"submitted": submitted, "kills": kills,
+            "partitions": partitions, "duration_s": duration_s,
+            "period_s": period_s, "lo_rps": lo_rps, "hi_rps": hi_rps}
+
+
 def run_load(base_url: str, job_id: str, *, sessions: int,
              duration_s: float, live: bool = False,
              timeout_s: float = 10.0) -> dict:
@@ -262,6 +348,28 @@ def run_load(base_url: str, job_id: str, *, sessions: int,
     }
 
 
+def _http_submit(base_url: str, input_path: str):
+    """Chaos-mode job submitter: copy the clip to a fresh path (the
+    watcher-style dedup keys on path) and POST /add_job."""
+    import os
+    import shutil
+    import urllib.request
+
+    base, ext = os.path.splitext(input_path)
+
+    def submit(i: int) -> None:
+        path = f"{base}.chaos{i:04d}{ext}"
+        if not os.path.exists(path):
+            shutil.copyfile(input_path, path)
+        body = json.dumps({"input_path": path}).encode()
+        req = urllib.request.Request(
+            base_url.rstrip("/") + "/add_job", data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=30).read()
+
+    return submit
+
+
 def main(argv: list[str] | None = None) -> int:
     from ..core.config import get_settings
 
@@ -269,16 +377,37 @@ def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="thinvids_tpu loadgen",
         description="replay concurrent HLS player sessions against "
-                    "the origin")
+                    "the origin, or (--chaos) drive a diurnal encode "
+                    "demand curve at the coordinator")
     p.add_argument("--url", required=True, help="origin base URL")
-    p.add_argument("--job", required=True, help="job id to play")
+    p.add_argument("--job", help="job id to play (player-load mode)")
     p.add_argument("--sessions", type=int,
                    default=int(snap.get("loadgen_sessions", 500)))
     p.add_argument("--duration", type=float,
                    default=float(snap.get("loadgen_duration_s", 10.0)))
     p.add_argument("--live", action="store_true",
                    help="use LL-HLS blocking reloads at the live edge")
+    p.add_argument("--chaos", action="store_true",
+                   help="diurnal job-submission curve against the "
+                        "coordinator's /add_job (worker kills and "
+                        "/work partitions need the in-process bench "
+                        "harness — bench.py _run_autoscale)")
+    p.add_argument("--input", help="clip to submit repeatedly "
+                                   "(--chaos mode)")
+    p.add_argument("--hi-rps", type=float, default=1.0,
+                   help="peak submissions/s of the diurnal curve")
     args = p.parse_args(argv)
+    if args.chaos:
+        if not args.input:
+            p.error("--chaos requires --input")
+        out = run_chaos_load(
+            _http_submit(args.url, args.input), args.duration,
+            period_s=chaos_defaults(snap)["period_s"],
+            hi_rps=args.hi_rps)
+        print(json.dumps(out))
+        return 0
+    if not args.job:
+        p.error("--job is required (unless --chaos)")
     out = run_load(args.url, args.job, sessions=args.sessions,
                    duration_s=args.duration, live=args.live)
     print(json.dumps(out))
