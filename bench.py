@@ -905,6 +905,216 @@ def _run_autoscale(w: int, h: int, nframes: int, qp: int,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _run_crash_resume(w: int, h: int, nframes: int, qp: int,
+                      gop_frames: int, *, workers: int = 2,
+                      kill_after_done: int | None = None,
+                      deadline_s: float = 300.0) -> dict:
+    """Durable-checkpoint figures under coordinator crash + data
+    corruption, through the PRODUCTION stack: a SUBPROCESS
+    ``cli.py coordinator`` (so it can be SIGKILLed for real) farming a
+    job to real worker daemons, with (1) one in-flight part upload
+    bit-flipped at ingest (the /work/chaos hook), (2) the coordinator
+    SIGKILLed once >= `kill_after_done` shards are spooled, and (3)
+    one spooled part bit-flipped on disk while the coordinator is
+    down. The restarted coordinator must resume from the board
+    checkpoint: verified parts rehydrate DONE, the corrupt one
+    re-encodes, and the job lands DONE byte-identical to an
+    UNINTERRUPTED run of the same clip.
+
+    Reported: ``crash_resume_shard_reuse_pct`` (rehydrated / total
+    shards on the crashed run — the work NOT re-encoded),
+    ``coordinator_recovery_s`` (restart exec → the resumed job
+    reporting progress again), and ``part_integrity_rejects`` (must
+    equal the injected corruption count — both flips caught, zero
+    corrupt bytes in any output). RAISES on any miss."""
+    import os
+    import shutil
+    import signal as _signal
+    import subprocess
+    import sys
+    import tempfile
+    import time as _time
+    import urllib.error
+    import urllib.request
+
+    from thinvids_tpu.core.types import VideoMeta
+    from thinvids_tpu.io.y4m import write_y4m
+    from thinvids_tpu.tools import loadgen
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix="tvt-crash-")
+    import socket as socket_mod
+
+    with socket_mod.socket() as sk:
+        sk.bind(("127.0.0.1", 0))
+        port = sk.getsockname()[1]
+    base = f"http://127.0.0.1:{port}"
+    state_dir = os.path.join(tmp, "state")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo,
+        TVT_EXECUTION_BACKEND="remote", TVT_MIN_IDLE_WORKERS="0",
+        TVT_PIPELINE_WORKER_COUNT="2", TVT_REMOTE_PLAN_DEVICES="8",
+        TVT_REMOTE_SHARD_GOPS="1", TVT_METRICS_TTL_S="3",
+        TVT_REMOTE_RETRY_BACKOFF_S="0.2", TVT_GOP_FRAMES=str(gop_frames),
+        TVT_QP=str(qp), TVT_SCHEDULER_POLL_S="0.5",
+        TVT_REMOTE_HTTP_RETRIES="12", TVT_REMOTE_HTTP_BACKOFF_S="0.2")
+
+    def call(path, method="GET", body=None, timeout=10):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(base + path, data=data,
+                                     method=method)
+        if data:
+            req.add_header("Content-Type", "application/json")
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+
+    def wait_for(predicate, budget_s, interval=0.25, what="condition"):
+        deadline = _time.monotonic() + budget_s
+        while _time.monotonic() < deadline:
+            try:
+                out = predicate()
+            except (urllib.error.URLError, ConnectionError, OSError):
+                out = None
+            if out:
+                return out
+            _time.sleep(interval)
+        raise RuntimeError(f"crash bench: timed out waiting for {what}")
+
+    def spawn_coordinator():
+        return subprocess.Popen(
+            [sys.executable, "-m", "thinvids_tpu.cli", "coordinator",
+             "--host", "127.0.0.1", "--port", str(port),
+             "--state-dir", state_dir,
+             "--output-dir", os.path.join(tmp, "library")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    def job_view(job_id):
+        return call(f"/job_properties/{job_id}")["job"]
+
+    meta = VideoMeta(width=w, height=h, fps_num=30, fps_den=1,
+                     num_frames=nframes)
+    clip_ref = os.path.join(tmp, "ref.y4m")
+    write_y4m(clip_ref, meta, make_frames(nframes, w, h))
+    clip_crash = os.path.join(tmp, "crash.y4m")
+    shutil.copyfile(clip_ref, clip_crash)
+
+    coord = spawn_coordinator()
+    worker_procs = []
+    try:
+        wait_for(lambda: call("/health", timeout=3), 45,
+                 what="coordinator API")
+        worker_procs = [subprocess.Popen(
+            [sys.executable, "-m", "thinvids_tpu.cli", "worker",
+             "--coordinator", base, "--node-name", f"crash-w{i}",
+             "--interval", "0.3", "--poll", "0.2"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            for i in range(workers)]
+        wait_for(lambda: len([n for n in call("/nodes_data")["nodes"]
+                              if n["host"].startswith("crash-w")])
+                 == workers, 30, what="workers registered")
+
+        # ---- reference: the same clip, uninterrupted ---------------
+        ref_job = call("/add_job", "POST", {"input_path": clip_ref})
+        ref_done = wait_for(
+            lambda: (job_view(ref_job["id"])
+                     if job_view(ref_job["id"])["status"]
+                     in ("done", "failed") else None),
+            deadline_s, what="reference job")
+        if ref_done["status"] != "done":
+            raise RuntimeError(f"crash bench: reference job failed: "
+                               f"{ref_done}")
+        with open(ref_done["output_path"], "rb") as fp:
+            want = fp.read()
+
+        # ---- crashed run -------------------------------------------
+        # (1) in-flight corruption: flip a bit in the next part upload
+        call("/work/chaos", "POST", {"corrupt_parts": 1})
+        job = call("/add_job", "POST", {"input_path": clip_crash})
+        wait_for(lambda: call("/metrics_snapshot")["work"]
+                 ["integrity_rejects"] >= 1 or None, 60,
+                 interval=0.1, what="in-flight corruption rejected")
+        pre_rejects = call("/metrics_snapshot")["work"][
+            "integrity_rejects"]
+        # (2) SIGKILL once enough shards are durably spooled: the
+        # reuse floor is 50% AFTER losing one part to the spool flip,
+        # so wait for total/2 + 2 completions (total known once the
+        # plan posts — it rounds GOPs to the plan-device width)
+        total_shards = wait_for(
+            lambda: int(job_view(job["id"])["parts_total"]) or None,
+            60, interval=0.1, what="shard plan posted")
+        threshold = kill_after_done if kill_after_done is not None \
+            else total_shards // 2 + 2
+        wait_for(lambda: (call("/work/board")["shards"]["done"]
+                          >= threshold) or None, 120,
+                 interval=0.05, what=f"{threshold}+ shards done")
+        coord.kill()
+        coord.wait(timeout=10)
+        # (3) storage rot while the coordinator is down
+        spooled = loadgen.corrupt_spooled_part(
+            os.path.join(state_dir, "part-spool"), job["id"])
+        if spooled is None:
+            raise RuntimeError("crash bench: no spooled part found "
+                               "to corrupt")
+        t_restart = _time.monotonic()
+        coord = spawn_coordinator()
+        wait_for(
+            lambda: (lambda v: v["status"] == "done"
+                     or (v["status"] in ("starting", "running")
+                         and v["parts_done"] > 0))(job_view(job["id"]))
+            or None, 90, interval=0.1,
+            what="resumed job reporting progress")
+        recovery_s = _time.monotonic() - t_restart
+        done = wait_for(
+            lambda: (job_view(job["id"])
+                     if job_view(job["id"])["status"]
+                     in ("done", "failed") else None),
+            deadline_s, what="crashed job terminal")
+        if done["status"] != "done":
+            raise RuntimeError(
+                f"crash bench: resumed job failed: {done}")
+        with open(done["output_path"], "rb") as fp:
+            got = fp.read()
+        if got != want:
+            raise RuntimeError(
+                "crash bench: resumed output is NOT byte-identical "
+                "to the uninterrupted run — the crash/corruption "
+                "path broke encode determinism")
+        snap = call("/metrics_snapshot")["work"]
+        resumed = int(snap["resumed"])
+        total = int(done["parts_total"])
+        reuse_pct = 100.0 * resumed / max(1, total)
+        rejects = pre_rejects + int(snap["integrity_rejects"])
+        if rejects != 2:
+            raise RuntimeError(
+                f"crash bench: {rejects} integrity rejects for 2 "
+                f"injected corruptions — a flip went unnoticed (or "
+                f"was double-counted)")
+        if reuse_pct < 50.0:
+            raise RuntimeError(
+                f"crash bench: only {reuse_pct:.0f}% of shards "
+                f"rehydrated from the spool (want >= 50%) — resume "
+                f"re-encoded finished work")
+        return {
+            "reuse_pct": round(reuse_pct, 1),
+            "recovery_s": round(recovery_s, 2),
+            "integrity_rejects": rejects,
+            "resumed_shards": resumed,
+            "total_shards": total,
+        }
+    finally:
+        for wp in worker_procs:
+            if wp.poll() is None:
+                wp.kill()
+                wp.wait(timeout=10)
+        if coord.poll() is None:
+            coord.send_signal(_signal.SIGTERM)
+            try:
+                coord.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                coord.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def build_result(r1080: dict, r4k: dict, *, platform: str, qp: int,
                  gop: int, n_1080: int, cold: dict | None = None,
                  ladder: dict | None = None,
@@ -912,7 +1122,8 @@ def build_result(r1080: dict, r4k: dict, *, platform: str, qp: int,
                  origin: dict | None = None,
                  sfe: dict | None = None,
                  trace: dict | None = None,
-                 autoscale: dict | None = None) -> dict:
+                 autoscale: dict | None = None,
+                 crash: dict | None = None) -> dict:
     """Assemble the one-line BENCH JSON from the two resolutions' runs
     (kept separate from main() so tests can assert the schema — e.g.
     the `stage_ms` breakdown and the `fps_cold_1080p` cold figure — on
@@ -1005,6 +1216,16 @@ def build_result(r1080: dict, r4k: dict, *, platform: str, qp: int,
         out["autoscale_jobs_done"] = autoscale["jobs_done"]
         out["chaos_worker_kills"] = autoscale["kills"]
         out["chaos_partitions"] = autoscale["partitions"]
+    if crash is not None:
+        # durable shard checkpointing under coordinator SIGKILL + data
+        # corruption: shards rehydrated from the verified spool (work
+        # NOT re-encoded on the crashed run), restart-to-progress
+        # recovery time, and the injected-corruption reject count —
+        # the measurement inside raises unless the resumed output is
+        # byte-identical, reuse >= 50% and rejects == injected flips
+        out["crash_resume_shard_reuse_pct"] = crash["reuse_pct"]
+        out["coordinator_recovery_s"] = crash["recovery_s"]
+        out["part_integrity_rejects"] = crash["integrity_rejects"]
     return out
 
 
@@ -1051,6 +1272,13 @@ def main() -> None:
     # every job lands DONE byte-identical and the farm breathes.
     r_autoscale = _run_autoscale(64, 48, 16, qp, 2)
 
+    # Durable checkpointing under chaos: SIGKILL a subprocess
+    # coordinator mid-farm-job, corrupt one in-flight upload and one
+    # spooled part, restart, and measure shard reuse + recovery time;
+    # raises unless the resumed output is byte-identical and every
+    # injected corruption was rejected before stitch.
+    r_crash = _run_crash_resume(64, 48, 24, qp, 2)
+
     # 4K rides with quality ON (psnr_y_2160p/ssim_y_2160p): 16 frames
     # keeps the untimed oracle decode affordable.
     n_4k = 16
@@ -1066,7 +1294,8 @@ def main() -> None:
                                   ladder=r_ladder, live=r_live,
                                   origin=r_origin, sfe=r_sfe,
                                   trace=r_trace,
-                                  autoscale=r_autoscale)))
+                                  autoscale=r_autoscale,
+                                  crash=r_crash)))
 
 
 if __name__ == "__main__":

@@ -752,6 +752,15 @@ class TestBoardModel:
         # drain must never strand a lease by suspending under it
         ("claim_while_draining", "lifecycle-claim"),
         ("suspend_with_lease", "drain-strands-lease"),
+        # durable checkpointing / crash-resume (ISSUE 13): a verified
+        # spooled part must rehydrate DONE (never re-lease), resume
+        # must not double-count attempts, and the two digest gates
+        # (ingest + pre-stitch) must keep corrupt bytes out of DONE
+        # shards and the stitched output
+        ("resume_leases_done", "resume-reuse"),
+        ("resume_burns_attempt", "attempt-accounting"),
+        ("ingest_no_verify", "part-integrity"),
+        ("stitch_no_verify", "part-integrity"),
     ])
     def test_seeded_mutation_yields_counterexample(self, mutation,
                                                    invariant):

@@ -71,10 +71,14 @@ def test_bench_result_schema_includes_stage_ms():
                  "alwayson_worker_s": 90.0, "jobs_done": 7,
                  "peak_workers": 3, "kills": 2, "partitions": 1,
                  "duration_s": 30.0}
+    crash = {"reuse_pct": 58.3, "recovery_s": 6.4,
+             "integrity_rejects": 2, "resumed_shards": 7,
+             "total_shards": 12}
     result = bench.build_result(r, r4k, platform="cpu", qp=27, gop=8,
                                 n_1080=64, cold=cold, ladder=ladder,
                                 live=live, origin=origin, sfe=sfe,
-                                trace=trace, autoscale=autoscale)
+                                trace=trace, autoscale=autoscale,
+                                crash=crash)
     assert result["value"] == 33.3
     assert set(STAGE_NAMES) <= set(result["stage_ms"])
     # sfe is a first-class stage key
@@ -138,6 +142,12 @@ def test_bench_result_schema_includes_stage_ms():
     assert result["autoscale_jobs_done"] == 7
     assert result["chaos_worker_kills"] == 2
     assert result["chaos_partitions"] == 1
+    # durable shard checkpointing under coordinator SIGKILL + data
+    # corruption (ISSUE 13): spool reuse on the crashed run, restart
+    # recovery time, and the injected-corruption reject count
+    assert result["crash_resume_shard_reuse_pct"] == 58.3
+    assert result["coordinator_recovery_s"] == 6.4
+    assert result["part_integrity_rejects"] == 2
 
 
 def test_run_trace_overhead_measures_both_paths():
@@ -214,6 +224,23 @@ def test_run_autoscale_breathes_under_chaos():
     assert 0 < r["active_worker_s"] < r["alwayson_worker_s"]
     assert r["kills"] >= 1
     assert r["partitions"] == 1
+
+
+@pytest.mark.slow
+def test_run_crash_resume_survives_sigkill_and_corruption():
+    """The crash bench SIGKILLs a subprocess coordinator mid-farm-job
+    with one in-flight upload and one spooled part bit-flipped, then
+    restarts it. The measurement itself raises unless the resumed
+    output is byte-identical to an uninterrupted run, >= 50% of
+    shards rehydrate from the spool, and BOTH injected corruptions
+    are rejected before stitch."""
+    r = bench._run_crash_resume(64, 48, 24, qp=27, gop_frames=2,
+                                workers=2)
+    assert r["reuse_pct"] >= 50.0
+    assert r["integrity_rejects"] == 2
+    assert r["recovery_s"] > 0
+    assert 1 <= r["resumed_shards"] <= r["total_shards"]
+    assert r["total_shards"] >= 12      # 24 frames / gop 2, >= 12 GOPs
 
 
 def test_run_ladder_reports_aggregate_and_shared_upload():

@@ -1,0 +1,524 @@
+"""Durable shard checkpointing (cluster/partstore.py + the board/
+executor integration in cluster/remote.py).
+
+Four layers:
+
+- `TestPartStore`: the spool + checkpoint journal in isolation —
+  atomic spool/commit, digest verification against bit flips, plan
+  signature semantics of begin_job, torn-tail journal replay, flock
+  ownership, the spool-bytes accounting.
+- `TestWireDigests`: the /work part framing's embedded sha256 — a
+  flipped payload bit raises PartIntegrityError at unpack.
+- `TestBoardSpool`: ShardBoard holds PartRefs instead of bytes (the
+  RAM un-pinning the ISSUE names), take_segments reads parts back from
+  the spool, integrity rejection requeues with NO attempt burned, and
+  the pre-stitch gate refuses corrupt spooled bytes.
+- `TestResume`: the executor-level crash-resume path — a second
+  coordinator over the same spool re-plans deterministically from the
+  checkpoint, rehydrates verified shards DONE under the fresh run
+  token, re-encodes corrupt ones, and respects resume_enabled and
+  signature drift.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from thinvids_tpu.cluster import Coordinator, WorkerRegistry
+from thinvids_tpu.cluster.jobs import Job
+from thinvids_tpu.cluster.partstore import (PartIntegrityError, PartRef,
+                                            PartStore)
+from thinvids_tpu.cluster.remote import (RemoteExecutor, Shard,
+                                         ShardBoard, pack_parts,
+                                         unpack_parts)
+from thinvids_tpu.core.config import DEFAULT_SETTINGS, Settings
+from thinvids_tpu.core.status import ShardState
+from thinvids_tpu.core.types import EncodedSegment, GopSpec, VideoMeta
+from thinvids_tpu.obs import metrics as obs_metrics
+
+
+def make_settings(**over):
+    values = dict(DEFAULT_SETTINGS)
+    values.update(over)
+    return Settings(values=values)
+
+
+def seg(index, payload=b"\0\0\1abc", start_frame=None, num_frames=2):
+    return EncodedSegment(
+        gop=GopSpec(index=index,
+                    start_frame=(2 * index if start_frame is None
+                                 else start_frame),
+                    num_frames=num_frames),
+        payload=payload, frame_sizes=(len(payload),))
+
+
+def make_shard(sid="j0-0000", key="0000", job_id="j0", gop0=0, ngops=2,
+               timeout_s=60.0):
+    gops = tuple(GopSpec(index=gop0 + i, start_frame=2 * (gop0 + i),
+                         num_frames=2) for i in range(ngops))
+    return Shard(id=sid, key=key, job_id=job_id, input_path="/in/a.y4m",
+                 meta=VideoMeta(width=64, height=48), gops=gops, qp=30,
+                 gop_frames=2, timeout_s=timeout_s)
+
+
+# the production chaos helper IS the test's corruption tool — one
+# implementation of the "flip past the framing header" knowledge
+# (tools/loadgen.py), so a framing change cannot silently leave the
+# tests flipping the wrong region
+from thinvids_tpu.tools.loadgen import flip_part_bit as flip_payload_bit
+
+
+class TestPartStore:
+    def test_spool_commit_read_roundtrip(self, tmp_path):
+        store = PartStore(str(tmp_path / "spool"))
+        try:
+            segs = [seg(0), seg(1, b"\0\0\1defgh")]
+            ref, tmp = store.spool("jobA", "0000", segs)
+            assert os.path.exists(tmp) and not os.path.exists(ref.path)
+            store.commit(ref, tmp)
+            assert os.path.exists(ref.path) and not os.path.exists(tmp)
+            back = store.read_part(ref)
+            assert [s.payload for s in back] == [s.payload for s in segs]
+            assert [s.gop for s in back] == [s.gop for s in segs]
+            assert store.spool_bytes() == ref.nbytes > 0
+            # the gauge follows the store's accounting
+            assert obs_metrics.PART_SPOOL_BYTES.get() == \
+                store.spool_bytes()
+        finally:
+            store.close()
+
+    def test_discard_drops_uncommitted_temp(self, tmp_path):
+        store = PartStore(str(tmp_path / "spool"))
+        try:
+            ref, tmp = store.spool("jobA", "0000", [seg(0)])
+            store.discard(tmp)
+            assert not os.path.exists(tmp)
+            assert store.spool_bytes() == 0
+        finally:
+            store.close()
+
+    def test_bit_flip_fails_verification(self, tmp_path):
+        store = PartStore(str(tmp_path / "spool"))
+        try:
+            ref, tmp = store.spool("jobA", "0000", [seg(0)])
+            store.commit(ref, tmp)
+            flip_payload_bit(ref.path)
+            assert not store.verify_part(ref)
+            with pytest.raises(PartIntegrityError):
+                store.read_part(ref)
+            # verification OFF reads the (corrupt) bytes — the escape
+            # hatch the part_integrity knob documents
+            assert store.read_part(ref, verify=False)
+        finally:
+            store.close()
+
+    def _plan(self, sig, keys):
+        return {"sig": sig, "gop_frames": 2, "num_devices": 1,
+                "plan_gops": [[i, 2 * i, 2, True]
+                              for i in range(len(keys))],
+                "shards": [{"key": k, "qp": 30,
+                            "gops": [[i, 2 * i, 2, True]],
+                            "timeout_s": 60.0, "rung": "",
+                            "rung_width": 0, "rung_height": 0}
+                           for i, k in enumerate(keys)]}
+
+    def test_begin_job_retains_on_matching_sig(self, tmp_path):
+        store = PartStore(str(tmp_path / "spool"))
+        try:
+            plan = self._plan("sigA", ["0000", "0001"])
+            assert store.begin_job("jobA", plan) == {}
+            ref, tmp = store.spool("jobA", "0000", [seg(0)])
+            store.commit(ref, tmp)
+            # same signature (the crash-resume case): record retained
+            kept = store.begin_job("jobA", plan)
+            assert set(kept) == {"0000"}
+            assert kept["0000"].digests == ref.digests
+            assert os.path.exists(ref.path)
+            # replay agrees
+            ck = store.load_job("jobA")
+            assert ck.plan["sig"] == "sigA" and set(ck.done) == {"0000"}
+        finally:
+            store.close()
+
+    def test_begin_job_resets_on_sig_drift(self, tmp_path):
+        store = PartStore(str(tmp_path / "spool"))
+        try:
+            store.begin_job("jobA", self._plan("sigA", ["0000"]))
+            ref, tmp = store.spool("jobA", "0000", [seg(0)])
+            store.commit(ref, tmp)
+            # operator changed qp → new signature: stale parts must
+            # never rehydrate, and their spool files drop
+            kept = store.begin_job("jobA", self._plan("sigB", ["0000"]))
+            assert kept == {}
+            assert not os.path.exists(ref.path)
+            assert store.spool_bytes() == 0
+        finally:
+            store.close()
+
+    def test_begin_job_reaps_orphan_spool_files(self, tmp_path):
+        """A crash between rename and journal append leaves a part
+        file no record names — begin_job sweeps it."""
+        store = PartStore(str(tmp_path / "spool"))
+        try:
+            plan = self._plan("sigA", ["0000"])
+            store.begin_job("jobA", plan)
+            ref, tmp = store.spool("jobA", "0000", [seg(0)])
+            os.replace(tmp, ref.path)       # renamed, never journaled
+            store.begin_job("jobA", plan)
+            assert not os.path.exists(ref.path)
+        finally:
+            store.close()
+
+    def test_drop_done_retracts_record(self, tmp_path):
+        store = PartStore(str(tmp_path / "spool"))
+        try:
+            plan = self._plan("sigA", ["0000"])
+            store.begin_job("jobA", plan)
+            ref, tmp = store.spool("jobA", "0000", [seg(0)])
+            store.commit(ref, tmp)
+            store.drop_done("jobA", "0000", ref)
+            assert not os.path.exists(ref.path)
+            assert store.load_job("jobA").done == {}
+            # the retraction survives a replay (journaled, not RAM)
+            assert store.begin_job("jobA", plan) == {}
+        finally:
+            store.close()
+
+    def test_torn_journal_tail_replays_prefix(self, tmp_path):
+        store = PartStore(str(tmp_path / "spool"))
+        store.begin_job("jobA", self._plan("sigA", ["0000", "0001"]))
+        ref, tmp = store.spool("jobA", "0000", [seg(0)])
+        store.commit(ref, tmp)
+        store.close()
+        jpath = str(tmp_path / "spool" / "jobA.board.jsonl")
+        with open(jpath, "ab") as fh:       # torn mid-append record
+            fh.write(b'{"op": "done", "key": "0001", "pa')
+        store2 = PartStore(str(tmp_path / "spool"))
+        try:
+            ck = store2.load_job("jobA")
+            assert ck is not None and set(ck.done) == {"0000"}
+        finally:
+            store2.close()
+
+    def test_clear_job_removes_everything(self, tmp_path):
+        store = PartStore(str(tmp_path / "spool"))
+        try:
+            store.begin_job("jobA", self._plan("sigA", ["0000"]))
+            ref, tmp = store.spool("jobA", "0000", [seg(0)])
+            store.commit(ref, tmp)
+            store.clear_job("jobA")
+            assert store.load_job("jobA") is None
+            assert not os.path.exists(ref.path)
+            assert store.spool_bytes() == 0
+        finally:
+            store.close()
+
+    def test_flock_exclusive_ownership(self, tmp_path):
+        root = str(tmp_path / "spool")
+        store = PartStore(root)
+        with pytest.raises(RuntimeError):
+            PartStore(root)
+        store.close()
+        PartStore(root).close()             # released: reopens cleanly
+
+    def test_restart_rescans_spool_bytes(self, tmp_path):
+        root = str(tmp_path / "spool")
+        store = PartStore(root)
+        ref, tmp = store.spool("jobA", "0000", [seg(0)])
+        store.commit(ref, tmp)
+        nbytes = store.spool_bytes()
+        store.close()
+        store2 = PartStore(root)
+        try:
+            assert store2.spool_bytes() == nbytes > 0
+        finally:
+            store2.close()
+
+
+class TestWireDigests:
+    def test_roundtrip_carries_digests(self):
+        data = pack_parts([seg(0), seg(1)])
+        hlen = int.from_bytes(data[:4], "big")
+        header = json.loads(data[4:4 + hlen])
+        assert all(len(r["sha256"]) == 64 for r in header["segments"])
+        assert len(unpack_parts(data)) == 2
+
+    def test_flipped_payload_bit_rejected(self):
+        data = bytearray(pack_parts([seg(0, b"\0\0\1" + b"x" * 64)]))
+        data[-10] ^= 0x01
+        with pytest.raises(PartIntegrityError):
+            unpack_parts(bytes(data))
+        # verification off: the documented escape hatch still parses
+        assert len(unpack_parts(bytes(data), verify=False)) == 1
+
+    def test_pre_digest_frame_still_parses(self):
+        """Old-format frames (no sha256 field) verify trivially —
+        rolling upgrades must not reject a pre-digest worker."""
+        segs = [seg(0)]
+        data = bytearray(pack_parts(segs))
+        hlen = int.from_bytes(data[:4], "big")
+        header = json.loads(data[4:4 + hlen])
+        for rec in header["segments"]:
+            del rec["sha256"]
+        new_header = json.dumps(header, separators=(",", ":")).encode()
+        data = (len(new_header).to_bytes(4, "big") + new_header
+                + bytes(data[4 + hlen:]))
+        assert len(unpack_parts(bytes(data))) == 1
+
+
+def make_board(tmp_path, clock=None, workers=("w1", "w2", "w3"), **over):
+    from tests.test_remote import FakeClock
+
+    clock = clock or FakeClock()
+    snap = make_settings(pipeline_worker_count=1, **over)
+    reg = WorkerRegistry(clock=clock)
+    for hostname in workers:
+        reg.heartbeat(hostname, metrics={"worker": True}, now=clock())
+    coord = Coordinator(registry=reg, clock=clock,
+                        settings_fn=lambda: snap)
+    board = ShardBoard(coord, clock=clock,
+                       spool_dir=str(tmp_path / "spool"))
+    return board, coord, clock
+
+
+class TestBoardSpool:
+    def test_done_shard_holds_ref_not_bytes(self, tmp_path):
+        """The board-memory fix made observable (ISSUE 13 satellite):
+        after submit, the DONE shard's payload is NOT resident — only
+        the PartRef — and take_segments reads it back from the
+        spool."""
+        board, coord, _ = make_board(tmp_path)
+        board.add_job("j0", [make_shard()], max_attempts=3,
+                      backoff_s=0.0, quarantine_after=3)
+        desc = board.claim("w2")
+        segs = [seg(0), seg(1, b"\0\0\1defgh")]
+        assert board.submit_part(desc["id"], "w2", segs)
+        shard = board._find_locked(desc["id"])
+        assert shard.state is ShardState.DONE
+        assert shard.segments == []             # un-pinned from RAM
+        assert os.path.exists(shard.part_path)
+        assert len(shard.part_digests) == 2
+        snap = board.snapshot()
+        assert snap["spool_bytes"] > 0
+        assert snap["integrity_rejects"] == 0
+        got = board.take_segments("j0")
+        assert [s.payload for s in got] == [s.payload for s in segs]
+
+    def test_reject_part_requeues_without_attempt_burn(self, tmp_path):
+        board, coord, _ = make_board(tmp_path)
+        board.add_job("j0", [make_shard()], max_attempts=3,
+                      backoff_s=5.0, quarantine_after=3)
+        desc = board.claim("w2")
+        board.reject_part(desc["id"], "w2", "digest mismatch")
+        shard = board._find_locked(desc["id"])
+        assert shard.state is ShardState.PENDING
+        assert shard.attempt == 0               # NO attempt burned
+        assert shard.not_before == 0.0          # and no backoff
+        assert board.snapshot()["integrity_rejects"] == 1
+        # the same (healthy) worker may re-claim immediately, and its
+        # quarantine streak is untouched
+        w2 = {w.host: w for w in coord.registry.all()}["w2"]
+        assert w2.consecutive_failures == 0
+        assert board.claim("w2") is not None
+
+    def test_persistent_rejection_escalates_to_failure(self, tmp_path):
+        """A deterministically corrupting link must not livelock the
+        job in a claim/encode/reject hot loop: past the free-reject
+        budget the rejection routes through the normal failure path
+        (attempt burned) until the job FAILS with attribution."""
+        board, coord, _ = make_board(tmp_path)
+        board.add_job("j0", [make_shard()], max_attempts=1,
+                      backoff_s=0.0, quarantine_after=99)
+        for _ in range(board.INTEGRITY_FREE_REJECTS):
+            desc = board.claim("w2")
+            board.reject_part(desc["id"], "w2", "flipped in transit")
+            shard = board._find_locked("j0-0000")
+            assert shard.attempt == 0           # transient flips: free
+            assert shard.state is ShardState.PENDING
+        desc = board.claim("w2")
+        board.reject_part(desc["id"], "w2", "flipped in transit")
+        shard = board._find_locked("j0-0000")
+        assert shard.attempt == 1               # escalated: burned
+        desc = board.claim("w2")
+        board.reject_part(desc["id"], "w2", "flipped in transit")
+        *_rest, failed, _host = board.job_progress("j0")
+        assert "persistent part corruption" in failed
+
+    def test_stale_reject_does_not_touch_new_holder(self, tmp_path):
+        board, coord, clock = make_board(tmp_path)
+        board.add_job("j0", [make_shard(timeout_s=10.0)], max_attempts=5,
+                      backoff_s=0.0, quarantine_after=99)
+        board.claim("w2")
+        clock.advance(11.0)
+        coord.registry.heartbeat("w3", now=clock())
+        board.requeue_expired()
+        board.claim("w3")
+        board.reject_part("j0-0000", "w2", "late corrupt upload")
+        shard = board._find_locked("j0-0000")
+        assert shard.state is ShardState.ASSIGNED
+        assert shard.assigned_host == "w3"      # w3's lease intact
+
+    def test_corrupt_spool_blocks_stitch(self, tmp_path):
+        """The pre-stitch gate: a bit that flipped on the spool disk
+        after accept fails the collect — corrupt bytes can never reach
+        concat."""
+        board, coord, _ = make_board(tmp_path)
+        board.add_job("j0", [make_shard()], max_attempts=3,
+                      backoff_s=0.0, quarantine_after=3)
+        desc = board.claim("w2")
+        board.submit_part(desc["id"], "w2", [seg(0), seg(1)])
+        shard = board._find_locked(desc["id"])
+        flip_payload_bit(shard.part_path)
+        with pytest.raises(RuntimeError, match="digest"):
+            board.take_shards("j0")
+
+    def test_duplicate_after_done_discards_spool_temp(self, tmp_path):
+        board, coord, _ = make_board(tmp_path)
+        board.add_job("j0", [make_shard()], max_attempts=3,
+                      backoff_s=0.0, quarantine_after=3)
+        desc = board.claim("w2")
+        segs = [seg(0), seg(1)]
+        assert board.submit_part(desc["id"], "w2", segs)
+        before = board.parts.spool_bytes()
+        assert not board.submit_part(desc["id"], "w3", segs)
+        assert board.parts.spool_bytes() == before
+        # no stray temp files beside the committed part
+        spool_dir = os.path.dirname(
+            board._find_locked(desc["id"]).part_path)
+        assert [f for f in os.listdir(spool_dir)
+                if f.endswith(".tmp")] == []
+
+
+# ---------------------------------------------------------------------------
+# executor-level crash-resume (in-process)
+# ---------------------------------------------------------------------------
+
+
+def make_rig(tmp_path, snap, job_id="deadbeefcafe0000",
+             spool="spool", workers=4):
+    reg = WorkerRegistry()
+    for i in range(workers):
+        reg.heartbeat(f"w{i:02d}", metrics={"worker": True})
+    coord = Coordinator(registry=reg, settings_fn=lambda: snap)
+    execu = RemoteExecutor(coord, output_dir=str(tmp_path / "lib"),
+                           sync=True, poll_s=0.02,
+                           spool_dir=str(tmp_path / spool))
+    return coord, execu
+
+
+def resume_settings(**over):
+    base = dict(gop_frames=2, qp=30, heartbeat_throttle_s=0.0,
+                remote_plan_devices=4, remote_shard_gops=1,
+                remote_no_worker_grace_s=5.0)
+    base.update(over)
+    return make_settings(**base)
+
+
+@pytest.fixture
+def crashed_run(tmp_path):
+    """A job whose first run accepted 2 of 4 shards, then the
+    coordinator 'crashed' (store closed without collect). Yields
+    (tmp_path, job, meta, settings, completed plan keys)."""
+    from tests.test_remote import write_clip
+
+    clip = tmp_path / "clip.y4m"
+    meta = write_clip(clip, n=8)        # 4 GOPs → 4 single-GOP shards
+    snap = resume_settings()
+    coord, execu = make_rig(tmp_path, snap)
+    job = Job(id="deadbeefcafe0000", input_path=str(clip), meta=meta)
+    plan, shards, reused = execu._plan_or_resume(
+        job, "aaaa1111", snap, meta, 8)
+    assert reused == 0 and len(shards) == 4
+    board = execu.board
+    board.add_job(job.id, shards, max_attempts=3, backoff_s=0.0,
+                  quarantine_after=3, token="aaaa1111")
+    done_keys = []
+    for host in ("w01", "w02"):
+        desc = board.claim(host)
+        from thinvids_tpu.cluster.remote import encode_shard
+        from thinvids_tpu.ingest.decode import read_video
+
+        segs = encode_shard(desc, read_video(str(clip))[1])
+        assert board.submit_part(desc["id"], host, segs)
+        done_keys.append(desc["id"].split("-")[-1])
+    execu.board.parts.close()           # the 'crash': flock released,
+    yield tmp_path, job, meta, snap, done_keys   # nothing collected
+
+
+class TestResume:
+    def test_resume_rehydrates_verified_shards(self, crashed_run):
+        tmp_path, job, meta, snap, done_keys = crashed_run
+        coord2, execu2 = make_rig(tmp_path, snap)
+        plan, shards, reused = execu2._plan_or_resume(
+            job, "bbbb2222", snap, meta, 8)
+        assert reused == 2
+        by_key = {s.key: s for s in shards}
+        for key in done_keys:
+            s = by_key[key]
+            assert s.state is ShardState.DONE and s.resumed
+            assert s.segments == [] and os.path.exists(s.part_path)
+            assert "bbbb22" in s.id     # fresh run token in the id
+        open_keys = set(by_key) - set(done_keys)
+        assert all(by_key[k].state is ShardState.PENDING
+                   for k in open_keys)
+        assert execu2.board.snapshot()["resumed"] == 2
+        execu2.board.parts.close()
+
+    def test_resume_drops_corrupt_spool(self, crashed_run):
+        tmp_path, job, meta, snap, done_keys = crashed_run
+        # chaos: one spooled part rots between crash and restart
+        spool_dir = str(tmp_path / "spool" / job.id)
+        victim = os.path.join(spool_dir, f"{done_keys[0]}.part")
+        flip_payload_bit(victim)
+        coord2, execu2 = make_rig(tmp_path, snap)
+        plan, shards, reused = execu2._plan_or_resume(
+            job, "bbbb2222", snap, meta, 8)
+        assert reused == 1              # only the intact part
+        by_key = {s.key: s for s in shards}
+        assert by_key[done_keys[0]].state is ShardState.PENDING
+        assert by_key[done_keys[0]].attempt == 0    # no attempt burn
+        assert by_key[done_keys[1]].state is ShardState.DONE
+        assert execu2.board.snapshot()["integrity_rejects"] == 1
+        # the retraction is durable: a THIRD restart re-encodes too
+        execu2.board.parts.close()
+        coord3, execu3 = make_rig(tmp_path, snap)
+        _p, shards3, reused3 = execu3._plan_or_resume(
+            job, "cccc3333", snap, meta, 8)
+        assert reused3 == 1
+        execu3.board.parts.close()
+
+    def test_resume_disabled_replans_fresh(self, crashed_run):
+        tmp_path, job, meta, snap, _done = crashed_run
+        snap2 = resume_settings(resume_enabled=False)
+        coord2, execu2 = make_rig(tmp_path, snap2)
+        _p, shards, reused = execu2._plan_or_resume(
+            job, "bbbb2222", snap2, meta, 8)
+        assert reused == 0
+        assert all(s.state is ShardState.PENDING for s in shards)
+        execu2.board.parts.close()
+
+    def test_signature_drift_resets_checkpoint(self, crashed_run):
+        tmp_path, job, meta, snap, _done = crashed_run
+        snap2 = resume_settings(qp=35)  # different encoded bytes
+        coord2, execu2 = make_rig(tmp_path, snap2)
+        _p, shards, reused = execu2._plan_or_resume(
+            job, "bbbb2222", snap2, meta, 8)
+        assert reused == 0
+        assert all(s.state is ShardState.PENDING for s in shards)
+        # the stale parts dropped with the reset
+        assert execu2.board.parts.spool_bytes() == 0
+        execu2.board.parts.close()
+
+    def test_resumed_plan_ignores_live_worker_count(self, crashed_run):
+        """Deterministic re-plan: the resumed run re-creates the
+        CHECKPOINTED plan even when the farm came back a different
+        size (planning from the live count would shift shard
+        boundaries and orphan every spooled part)."""
+        tmp_path, job, meta, snap, done_keys = crashed_run
+        coord2, execu2 = make_rig(tmp_path, snap, workers=1)
+        plan, shards, reused = execu2._plan_or_resume(
+            job, "bbbb2222", snap, meta, 8)
+        assert len(shards) == 4 and reused == 2
+        execu2.board.parts.close()

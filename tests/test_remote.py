@@ -700,6 +700,138 @@ class TestWorkApi:
         assert getattr(ei.value, "status", None) == 503
 
 
+class TestWorkerResilience:
+    """ISSUE 13 satellite: jittered-backoff retries on the worker's
+    HTTP surface — the coordinator's restart window (refused
+    connections, 5xx) must neither fail shards nor quarantine healthy
+    workers, and an integrity-rejected upload must heal by re-sending
+    the idempotent part, not by re-encoding."""
+
+    def _rig(self, tmp_path):
+        snap = make_settings(gop_frames=2, qp=30,
+                             pipeline_worker_count=0,
+                             heartbeat_throttle_s=0.0)
+        reg = WorkerRegistry()
+        reg.heartbeat("w-res", metrics={"worker": True})
+        coord = Coordinator(registry=reg, settings_fn=lambda: snap)
+        board = ShardBoard(coord, spool_dir=str(tmp_path / "spool"))
+        return coord, board
+
+    def _real_shard(self, clip, meta, sid="jres-0000"):
+        gops = tuple(GopSpec(index=i, start_frame=2 * i, num_frames=2)
+                     for i in range(2))
+        return Shard(id=sid, key="0000", job_id="jres",
+                     input_path=str(clip), meta=meta, gops=gops,
+                     qp=30, gop_frames=2, timeout_s=120.0)
+
+    def test_claim_loop_survives_api_bounce(self, tmp_path):
+        from thinvids_tpu.api.server import ApiServer
+        from thinvids_tpu.cluster.remote import WorkerDaemon
+
+        clip = tmp_path / "clip.y4m"
+        meta = write_clip(clip, n=4)
+        coord, board = self._rig(tmp_path)
+        api = ApiServer(coord, work=board).start()
+        port = api.port
+        client = WorkerClient(api.url, timeout_s=5.0, retries=40,
+                              backoff_s=0.05)
+        daemon = WorkerDaemon(api.url, host="w-res", poll_s=0.05,
+                              client=client)
+        stop = threading.Event()
+        threading.Thread(target=daemon.run_forever, args=(stop,),
+                         daemon=True).start()
+        try:
+            time.sleep(0.3)             # daemon is mid-claim-loop
+            api.stop()                  # bounce: restart window begins
+            time.sleep(0.5)
+            api = ApiServer(coord, host="127.0.0.1", port=port,
+                            work=board).start()
+            # work posted AFTER the bounce: the retrying claim loop
+            # must find it without ever surfacing a shard failure
+            board.add_job("jres", [self._real_shard(clip, meta)],
+                          max_attempts=3, backoff_s=0.0,
+                          quarantine_after=3)
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                done, total, *_rest = board.job_progress("jres")
+                if total and done >= total:
+                    break
+                coord.registry.heartbeat("w-res",
+                                         metrics={"worker": True})
+                time.sleep(0.1)
+            done, total, retried, failed, _h = board.job_progress("jres")
+            assert (done, total, failed) == (2, 2, "")
+            assert retried == 0
+            assert daemon.shards_failed == 0
+            assert daemon.shards_done == 1
+        finally:
+            stop.set()
+            api.stop()
+
+    def test_upload_retries_through_integrity_reject(self, tmp_path):
+        """An upload corrupted in transit: ingest rejects on digest,
+        the lease comes straight back, and the worker's retry of the
+        same (idempotent) upload lands — no attempt burned, no
+        quarantine accounting, no re-encode."""
+        from thinvids_tpu.api.server import ApiServer
+        from thinvids_tpu.cluster.remote import WorkerDaemon
+
+        clip = tmp_path / "clip.y4m"
+        meta = write_clip(clip, n=4)
+        coord, board = self._rig(tmp_path)
+        api = ApiServer(coord, work=board).start()
+        try:
+            board.add_job("jres", [self._real_shard(clip, meta)],
+                          max_attempts=3, backoff_s=0.0,
+                          quarantine_after=3)
+            api.corrupt_parts(1)        # chaos: flip a bit in the next
+            client = WorkerClient(      # upload body before unpack
+                api.url, timeout_s=5.0, retries=5, backoff_s=0.05)
+            daemon = WorkerDaemon(api.url, host="w-res", poll_s=0.05,
+                                  client=client)
+            assert daemon.step()        # one claim → encode → upload
+            done, total, retried, failed, _h = board.job_progress("jres")
+            assert (done, total, failed) == (2, 2, "")
+            assert retried == 0                      # no attempt burn
+            assert daemon.shards_done == 1
+            assert daemon.shards_failed == 0
+            snap = board.snapshot()
+            assert snap["integrity_rejects"] == 1
+            w = {x.host: x for x in coord.registry.all()}["w-res"]
+            assert w.consecutive_failures == 0
+        finally:
+            api.stop()
+
+    def test_upload_gives_up_after_retry_budget(self, tmp_path):
+        """Every retry rejected (persistent corruption): upload_part
+        returns False instead of looping forever."""
+        from thinvids_tpu.api.server import ApiServer
+
+        clip = tmp_path / "clip.y4m"
+        meta = write_clip(clip, n=4)
+        coord, board = self._rig(tmp_path)
+        api = ApiServer(coord, work=board).start()
+        try:
+            board.add_job("jres", [self._real_shard(clip, meta)],
+                          max_attempts=5, backoff_s=0.0,
+                          quarantine_after=9)
+            client = WorkerClient(api.url, timeout_s=5.0, retries=2,
+                                  backoff_s=0.01)
+            desc = board.claim("w-res")
+            api.corrupt_parts(10)       # poison every retry
+            segs = encode_shard(desc, read_video_frames(str(clip)))
+            assert client.upload_part(desc["id"], "w-res", segs) is False
+            assert board.snapshot()["integrity_rejects"] == 3
+        finally:
+            api.stop()
+
+
+def read_video_frames(path):
+    from thinvids_tpu.ingest.decode import read_video
+
+    return read_video(path)[1]
+
+
 # ---------------------------------------------------------------------------
 # hermetic multi-process farm (the acceptance test)
 # ---------------------------------------------------------------------------
@@ -824,6 +956,99 @@ def test_farm_end_to_end_with_worker_kill(tmp_path):
             coord.wait(timeout=15)
         except subprocess.TimeoutExpired:
             coord.kill()
+
+
+def test_coordinator_crash_resume_end_to_end(tmp_path):
+    """Acceptance (ISSUE 13): the coordinator is SIGKILLed mid-farm-job
+    and restarted over the same state dir. The job must land DONE with
+    output BYTE-identical to an uninterrupted run, with >= 1 shard
+    rehydrated from the durable part spool (the reuse counter) instead
+    of re-encoded — and a spool corruption injected while the
+    coordinator was down must be rejected at resume, never stitched."""
+    import socket as socket_mod
+
+    clip = tmp_path / "clip.y4m"
+    meta = write_clip(clip, n=28)       # 14 GOPs → 14 1-GOP shards
+    ref_settings = make_settings(gop_frames=2, qp=30,
+                                 heartbeat_throttle_s=0.0)
+    want = local_reference_bytes(tmp_path / "ref", clip, meta,
+                                 ref_settings)
+
+    with socket_mod.socket() as sk:
+        sk.bind(("127.0.0.1", 0))
+        port = sk.getsockname()[1]
+    base = f"http://127.0.0.1:{port}"
+    env = dict(_farm_env(tmp_path),
+               TVT_REMOTE_HTTP_RETRIES="12",
+               TVT_REMOTE_HTTP_BACKOFF_S="0.2")
+    state_dir = str(tmp_path / "state")
+
+    def spawn_coordinator():
+        return subprocess.Popen(
+            [sys.executable, "-m", "thinvids_tpu.cli", "coordinator",
+             "--host", "127.0.0.1", "--port", str(port),
+             "--state-dir", state_dir,
+             "--output-dir", str(tmp_path / "library")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    coord = spawn_coordinator()
+    workers = []
+    try:
+        _wait(lambda: _try_health(base), 45, what="coordinator API")
+        workers = [_spawn_worker(base, f"crash-w{i}", env)
+                   for i in range(2)]
+        _wait(lambda: len([n for n in _call(base, "/nodes_data")["nodes"]
+                           if n["host"].startswith("crash-w")]) == 2,
+              30, what="both workers registered")
+        job = _call(base, "/add_job", "POST", {"input_path": str(clip)})
+
+        def partially_done():
+            try:
+                done = _call(base, "/work/board")["shards"]["done"]
+            except Exception:   # noqa: BLE001 - board not up yet
+                return None
+            return done if done >= 4 else None
+
+        _wait(partially_done, 120, interval=0.1,
+              what="4+ shards spooled before the crash")
+        coord.kill()                    # SIGKILL, no journal goodbye
+        coord.wait(timeout=10)
+
+        # chaos: one spooled part rots while the coordinator is down
+        # (the production chaos helper the bench tier uses)
+        from thinvids_tpu.tools.loadgen import corrupt_spooled_part
+
+        spool_dir = os.path.join(state_dir, "part-spool", job["id"])
+        assert corrupt_spooled_part(
+            os.path.join(state_dir, "part-spool"), job["id"]) is not None
+
+        coord = spawn_coordinator()     # restart over the same state
+        _wait(lambda: _try_health(base), 45,
+              what="coordinator API after restart")
+        done = _wait(lambda: _job_if_terminal(base, job["id"]), 240,
+                     what="job terminal after coordinator restart")
+        assert done["status"] == "done", done
+        with open(done["output_path"], "rb") as fp:
+            assert fp.read() == want    # byte-identical despite the
+                                        # crash AND the corruption
+        snap = _call(base, "/metrics_snapshot")["work"]
+        assert snap["resumed"] >= 1, snap       # spool reuse, not a
+                                                # full re-encode
+        assert snap["integrity_rejects"] >= 1, snap  # the flipped part
+                                                # was caught at resume
+        # the finished job released its checkpoint + spool
+        assert not os.path.exists(spool_dir)
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.wait(timeout=10)
+        if coord.poll() is None:
+            coord.send_signal(signal.SIGTERM)
+            try:
+                coord.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                coord.kill()
 
 
 def _try_health(base):

@@ -202,6 +202,10 @@ class Manifest:
         "thinvids_tpu.origin",          # whole package
         "thinvids_tpu.tools.loadgen",
         "thinvids_tpu.cluster.qos",
+        # the durable part spool + board checkpoint runs on coordinator
+        # control-plane threads (API handlers, the drain loop) — never
+        # on a mesh
+        "thinvids_tpu.cluster.partstore",
         # the observability layer (metrics registry, trace store,
         # flight recorder) runs on coordinator/worker control-plane
         # threads and inside jax-free sidecars
@@ -297,7 +301,11 @@ class Manifest:
         default_factory=lambda: {
             "thinvids_tpu.cluster.remote:ShardBoard._jobs": "_lock",
             "thinvids_tpu.cluster.remote:ShardBoard._order": "_lock",
+            "thinvids_tpu.cluster.remote:ShardBoard._parts": "_lock",
             "thinvids_tpu.cluster.jobs:JobStore._jobs": "_lock",
+            "thinvids_tpu.cluster.partstore:PartStore._journals": "_lock",
+            "thinvids_tpu.cluster.partstore:PartStore._spool_bytes":
+                "_lock",
             "thinvids_tpu.cluster.coordinator:WorkerRegistry._workers":
                 "_lock",
             "thinvids_tpu.cluster.coordinator:Coordinator._active_ids":

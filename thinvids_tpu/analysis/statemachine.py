@@ -41,7 +41,13 @@ TVT-M002  **bounded model checking.** A faithful, pure model of the
             shard was DONE;
           - ``qos-gate``: no batch claim while the gate is closed;
           - ``open-shard-unreachable``: no reachable terminal state
-            strands an open (PENDING/ASSIGNED) shard.
+            strands an open (PENDING/ASSIGNED) shard;
+          - ``resume-reuse``: a shard whose VERIFIED part sits on the
+            durable spool is never re-leased — crash-resume must
+            rehydrate it DONE (cluster/partstore.py);
+          - ``part-integrity``: no shard reaches DONE on a
+            digest-mismatched part, and no collect stitches one —
+            the two gates that keep corrupt bytes out of the output.
 
           Violations carry the violated invariant and the exact
           action interleaving (BFS ⇒ a shortest counterexample,
@@ -373,7 +379,22 @@ MUTATIONS = (
     "claim_while_draining",  # claims ignore the worker lifecycle gate
     "suspend_with_lease",    # suspend fires while the worker holds a
                              # lease (drain strands it)
+    # -- durable checkpoint / crash-resume (cluster/partstore.py) ----
+    "resume_leases_done",    # crash-resume drops verified spooled
+                             # parts back to PENDING (re-encodes work
+                             # the spool already holds)
+    "resume_burns_attempt",  # resume's requeue of unverifiable shards
+                             # counts as a shard failure
+    "ingest_no_verify",      # /work ingest accepts a digest-mismatched
+                             # part as DONE
+    "stitch_no_verify",      # collect stitches a spooled part whose
+                             # digest no longer verifies
 )
+
+#: per-shard durable-spool states (the ckpt component of the model
+#: state): nothing spooled / a verified part on disk / a part whose
+#: bytes rotted after it was accepted
+CK_NONE, CK_GOOD, CK_CORRUPT = 0, 1, 2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -402,7 +423,7 @@ class Violation:
 
 # State layout (all tuples — hashable, structurally comparable):
 #   (t, run, entry_run|None, shards, workers, gate_open, fails,
-#    collected, lifecycles)
+#    collected, lifecycles, ckpt)
 # shard: (state, attempt, host|"", deadline, not_before, finisher|"",
 #         seq)
 # worker: None (idle) | (shard_idx, descriptor_run, lease_seq)
@@ -410,6 +431,10 @@ class Violation:
 #            farm machine; scenarios without lifecycle actions leave
 #            every worker ACTIVE, which collapses to the old state
 #            space)
+# ckpt: per-shard durable-spool state (CK_NONE/CK_GOOD/CK_CORRUPT):
+#       the partstore checkpoint that SURVIVES a coordinator crash.
+#       In scenarios without crash/corrupt actions it tracks DONE
+#       bijectively, adding no states.
 
 _FRESH_SHARD = (PENDING, 0, "", 0, 0, "", 0)
 #: shard tuple field order, resolved once (apply() updates fields by
@@ -422,7 +447,7 @@ _FIELD_IDX = {name: i for i, name in enumerate(
 def _initial(cfg: ModelConfig):
     return (0, 1, 1, (_FRESH_SHARD,) * cfg.shards,
             (None,) * cfg.workers, True, 0, False,
-            (ACTIVE,) * cfg.workers)
+            (ACTIVE,) * cfg.workers, (CK_NONE,) * cfg.shards)
 
 
 class BoardModel:
@@ -449,7 +474,7 @@ class BoardModel:
 
     def enabled(self, s, actions: tuple[str, ...]) -> list[tuple]:
         (t, run, entry, shards, workers, gate, fails, collected,
-         lifecycles) = s
+         lifecycles, ckpt) = s
         out: list[tuple] = []
         for act in actions:
             if act == "claim" and entry is not None and \
@@ -458,9 +483,17 @@ class BoardModel:
                     out.extend(("claim", w) for w in range(len(workers))
                                if workers[w] is None
                                and self._may_claim(lifecycles[w]))
-            elif act in ("submit", "fail", "die"):
+            elif act in ("submit", "fail", "die", "submit_bad"):
                 out.extend((act, w) for w in range(len(workers))
                            if workers[w] is not None)
+            elif act == "corrupt":
+                # chaos bit-flip on an already-spooled part
+                out.extend(("corrupt", i) for i in range(len(ckpt))
+                           if ckpt[i] == CK_GOOD)
+            elif act == "crash" and run == 1 and entry is not None:
+                # coordinator SIGKILL + restart-with-resume (one per
+                # exploration, like restart; workers keep running)
+                out.append(("crash",))
             elif act == "tick" and t < self.cfg.t_max:
                 out.append(("tick",))
             elif act == "sweep" and "no_expiry" not in self.mut and \
@@ -512,7 +545,7 @@ class BoardModel:
         return out
 
     def _claimable(self, s) -> int | None:
-        t, _run, _entry, shards, _w, _g, _f, _c, _lc = s
+        t, _run, _entry, shards, _w, _g, _f, _c, _lc, _ck = s
         for i, sh in enumerate(shards):
             open_enough = sh[0] == PENDING or (
                 "double_assign" in self.mut and sh[0] == ASSIGNED)
@@ -528,7 +561,7 @@ class BoardModel:
         carries per-action facts the invariants read (including
         `wedges`, the worker-lifecycle edges this action took)."""
         (t, run, entry, shards, workers, gate, fails, collected,
-         lifecycles) = s
+         lifecycles, ckpt) = s
         cfg = self.cfg
         kind = action[0]
         notes: dict = {}
@@ -545,6 +578,10 @@ class BoardModel:
             if "state" in ch:
                 edges.append((i, pre, ch["state"]))
 
+        def spool(i, val):
+            nonlocal ckpt
+            ckpt = ckpt[:i] + (val,) + ckpt[i + 1:]
+
         def move(w, to):
             nonlocal lifecycles
             wedges.append((w, lifecycles[w], to))
@@ -556,6 +593,7 @@ class BoardModel:
             notes["claim_pre"] = shards[i][0]
             notes["gate_open"] = gate
             notes["claim_lifecycle"] = lifecycles[w]
+            notes["claim_ckpt"] = ckpt[i]
             seq = shards[i][6] + 1
             upd(i, state=ASSIGNED, host=f"w{w}",
                 deadline=min(t + cfg.timeout, cfg.t_max - 1), seq=seq)
@@ -569,10 +607,31 @@ class BoardModel:
             if resolvable and shards[i][0] in _OPEN:
                 if desc_run != run:
                     notes["cross_run_accept"] = True
+                # the accept spools the (verified) part durably before
+                # the shard flips DONE (partstore.commit)
+                spool(i, CK_GOOD)
                 upd(i, state=DONE, host="", finisher=f"w{w}")
             elif resolvable and shards[i][0] == DONE and \
                     "accept_after_done" in self.mut:
                 upd(i, state=DONE, finisher=f"w{w}")
+        elif kind == "submit_bad":
+            # the worker's upload corrupted in transit: ingest digest
+            # verification rejects it and hands the lease straight
+            # back (NO attempt burned — a transfer fault). Under the
+            # `ingest_no_verify` mutation the corrupt bytes land as a
+            # DONE shard with a rotten spool record.
+            w = action[1]
+            i, desc_run, seq = workers[w]
+            workers = workers[:w] + (None,) + workers[w + 1:]
+            resolvable = entry is not None and desc_run == run
+            if not resolvable:
+                pass                  # cross-run bad part: dropped
+            elif "ingest_no_verify" in self.mut and \
+                    shards[i][0] in _OPEN:
+                spool(i, CK_CORRUPT)
+                upd(i, state=DONE, host="", finisher=f"w{w}")
+            elif shards[i][0] == ASSIGNED and shards[i][6] == seq:
+                upd(i, state=PENDING, host="", not_before=t)
         elif kind == "fail":
             w = action[1]
             i, desc_run, seq = workers[w]
@@ -608,6 +667,38 @@ class BoardModel:
             shards = (_FRESH_SHARD,) * cfg.shards
             fails = 0
             edges = []                   # new entry: no edges carried
+            # operator restart re-anchors the checkpoint (settings may
+            # have changed → signature drift → partstore.begin_job
+            # resets); crash-resume is the `crash` action instead
+            ckpt = (CK_NONE,) * cfg.shards
+        elif kind == "corrupt":
+            # chaos: a bit flips on the spool disk AFTER the part was
+            # accepted — invisible until the next verification gate
+            # (resume rehydration or the pre-stitch check)
+            spool(action[1], CK_CORRUPT)
+        elif kind == "crash":
+            # coordinator SIGKILL + restart: the board's RAM state is
+            # gone, the journal + spool survive, the workers keep
+            # running with run-1 descriptors. recover_jobs requeues
+            # under a fresh token and the executor re-plans FROM the
+            # checkpoint: verified spooled parts rehydrate DONE
+            # (PENDING→DONE, the declared late-part edge — no attempt
+            # counted), unverifiable ones re-encode (no attempt
+            # burned: storage fault, not worker fault).
+            run, entry = 2, 2
+            fails = 0
+            edges = []                   # fresh entry: no edges carried
+            shards = (_FRESH_SHARD,) * cfg.shards
+            for i in range(cfg.shards):
+                if ckpt[i] == CK_GOOD:
+                    if "resume_leases_done" in self.mut:
+                        continue         # verified part ignored: the
+                                         # shard re-encodes (the break)
+                    upd(i, state=DONE, host="", finisher="resume")
+                elif ckpt[i] == CK_CORRUPT:
+                    spool(i, CK_NONE)    # retracted + unlinked
+                    if "resume_burns_attempt" in self.mut:
+                        upd(i, attempt=1)
         elif kind in ("cancel", "cancel_stale"):
             if kind == "cancel" or "no_token_fence" in self.mut:
                 entry = None
@@ -624,9 +715,25 @@ class BoardModel:
         elif kind == "collect":
             notes["open_at_collect"] = [
                 i for i, sh in enumerate(shards) if sh[0] != DONE]
-            entry = None
-            shards = ()
-            collected = True
+            notes["corrupt_at_collect"] = [
+                i for i, sh in enumerate(shards)
+                if sh[0] == DONE and ckpt[i] == CK_CORRUPT]
+            if notes["corrupt_at_collect"] and \
+                    "stitch_no_verify" not in self.mut:
+                # the pre-stitch digest gate refuses: the job FAILS
+                # with attribution (no output commits) and the
+                # CHECKPOINT SURVIVES — a later restart resumes the
+                # verified parts and re-encodes the corrupt one.
+                # Modeled as the entry closing WITHOUT the collected
+                # output, ckpt retained (matching clear_job NOT
+                # running on the failure path).
+                entry = None
+                shards = ()
+            else:
+                entry = None
+                shards = ()
+                collected = True
+                ckpt = (CK_NONE,) * cfg.shards   # clear_job on DONE
         elif kind == "drain":
             move(action[1], DRAINING)
         elif kind == "undrain":
@@ -652,7 +759,7 @@ class BoardModel:
             raise AssertionError(f"unknown action {action}")
         notes["wedges"] = wedges
         return ((t, run, entry, shards, workers, gate, fails, collected,
-                 lifecycles), edges, notes)
+                 lifecycles, ckpt), edges, notes)
 
     def _burn(self, shards, i, t, fails):
         """One failure event against shard i (worker report or lease
@@ -694,6 +801,11 @@ def _check_transition(pre, action, post, edges, notes,
         return ("lifecycle-claim",
                 f"shard leased to a {notes['claim_lifecycle']} worker "
                 f"(only ACTIVE workers may claim)")
+    if kind == "claim" and notes.get("claim_ckpt", CK_NONE) == CK_GOOD:
+        return ("resume-reuse",
+                "shard re-leased although a VERIFIED spooled part "
+                "exists for it — crash-resume must rehydrate it DONE, "
+                "never re-encode finished work")
     if kind == "suspend" and notes.get("suspend_held_lease"):
         return ("drain-strands-lease",
                 "suspend fired while the worker still held an open "
@@ -706,11 +818,28 @@ def _check_transition(pre, action, post, edges, notes,
                         f"worker w{w}: {a}→{b} via "
                         f"{_fmt_action(action)} is not in the declared "
                         f"worker-lifecycle table")
+    # part-integrity: no shard may reach DONE on a corrupt part, and
+    # no collect may succeed while a DONE shard's spool record fails
+    # verification — the two gates (ingest digests, pre-stitch
+    # re-verify) that keep corrupt bytes out of the output tree
+    post_ckpt = post[9]
+    for i, _a, b in edges:
+        if b == DONE and post_ckpt[i] == CK_CORRUPT:
+            return ("part-integrity",
+                    f"shard {i} accepted as DONE via "
+                    f"{_fmt_action(action)} although its part fails "
+                    f"digest verification")
+    if kind == "collect" and post[7] and notes.get("corrupt_at_collect"):
+        return ("part-integrity",
+                f"collect stitched shard(s) "
+                f"{notes['corrupt_at_collect']} whose spooled parts "
+                f"fail digest verification — corrupt bytes reached "
+                f"the output tree")
     # done-absorbs BEFORE the generic edge check: overwriting a DONE
     # shard must be named as the first-result-wins break it is, not as
     # a generic undeclared DONE→DONE edge
-    if kind not in ("restart", "cancel", "collect", "cancel_stale",
-                    "collect_stale"):
+    if kind not in ("restart", "crash", "cancel", "collect",
+                    "cancel_stale", "collect_stale"):
         pre_shards, post_shards = pre[3], post[3]
         for i, sh in enumerate(pre_shards):
             if sh[0] == DONE and (post_shards[i][0] != DONE
@@ -749,7 +878,7 @@ def _check_transition(pre, action, post, edges, notes,
 
 def _check_terminal(state) -> tuple[str, str] | None:
     (t, run, entry, shards, workers, gate, fails, collected,
-     _lifecycles) = state
+     _lifecycles, _ckpt) = state
     if entry is None:
         return None
     open_ = [i for i, sh in enumerate(shards) if sh[0] in _OPEN]
@@ -801,6 +930,15 @@ SCENARIOS: tuple[Scenario, ...] = (
     Scenario("drain", ("claim", "submit", "tick", "sweep", "drain",
                        "undrain", "suspend", "wake", "wake_fail",
                        "rejoin", "hb"), depth=8,
+             cfg=ModelConfig(shards=2, t_max=3)),
+    # durable checkpointing: coordinator SIGKILL + resume driven
+    # against spool corruption and corrupt in-flight uploads. Proves a
+    # verified spooled part is never re-leased (rehydrates DONE), an
+    # unverifiable one re-encodes with no attempt burned, a
+    # digest-mismatched upload takes only the declared
+    # ASSIGNED→PENDING edge, and corrupt bytes can never be collected.
+    Scenario("crash", ("claim", "submit", "submit_bad", "corrupt",
+                       "crash", "tick", "sweep", "collect"), depth=8,
              cfg=ModelConfig(shards=2, t_max=3)),
 )
 

@@ -157,6 +157,11 @@ class ApiServer:
         #: (written by the chaos thread, read by every handler thread).
         self._fault_lock = threading.Lock()
         self._work_partition_until = 0.0
+        #: chaos: bit-flip the next N /work/part upload bodies before
+        #: unpack (the in-flight corruption the crash/corruption bench
+        #: tier injects — every flip must surface as a digest
+        #: rejection, never as corrupt stitched bytes)
+        self._corrupt_parts_left = 0
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -402,6 +407,7 @@ class ApiServer:
         ("POST", r"^/work/part/(?P<shard_id>[\w:-]+)$", "work_part"),
         ("POST", r"^/work/spans$", "work_spans"),
         ("POST", r"^/work/status$", "work_status"),
+        ("POST", r"^/work/chaos$", "work_chaos"),
         ("GET", r"^/work/board$", "work_board"),
         ("GET", r"^/settings$", "get_settings"),
         ("POST", r"^/settings$", "post_settings"),
@@ -1009,6 +1015,26 @@ class ApiServer:
             self._work_partition_until = time.monotonic() + max(
                 0.0, float(seconds))
 
+    def corrupt_parts(self, n: int) -> None:
+        """Chaos: flip one bit in each of the next `n` part-upload
+        bodies before they unpack — the in-flight transfer corruption
+        the integrity layer must reject (and the worker's idempotent
+        re-upload must then heal with no attempt burned)."""
+        with self._fault_lock:
+            self._corrupt_parts_left += max(0, int(n))
+
+    def _maybe_corrupt_part(self, raw: bytes) -> bytes:
+        with self._fault_lock:
+            if self._corrupt_parts_left <= 0:
+                return raw
+            self._corrupt_parts_left -= 1
+        flipped = bytearray(raw)
+        if flipped:
+            # deterministic mid-body flip: lands in a payload for any
+            # realistically sized part (headers are a small prefix)
+            flipped[len(flipped) // 2] ^= 0x40
+        return bytes(flipped)
+
     def _work_board_or_503(self):
         if self.work is None:
             raise ApiError(503, "no remote work backend "
@@ -1040,8 +1066,24 @@ class ApiServer:
         if not isinstance(raw, (bytes, bytearray)):
             raise ApiError(400, "binary part body required "
                                 "(Content-Type: application/octet-stream)")
-        segments = unpack_parts(bytes(raw))
-        ok = board.submit_part(shard_id, host, segments)
+        raw = self._maybe_corrupt_part(bytes(raw))
+        verify = as_bool(self.coordinator._settings_fn().get(
+            "part_integrity", True), True)
+        try:
+            segments = unpack_parts(raw, verify=verify)
+        except ValueError as exc:
+            # torn frame OR digest mismatch: the bytes corrupted in
+            # TRANSIT — a transfer fault, not a worker fault. The
+            # lease goes straight back (no attempt burned, counted in
+            # tvt_part_integrity_failures_total) and the worker is
+            # told to re-send its idempotent upload.
+            board.reject_part(shard_id, host, str(exc))
+            return 200, {"ok": False, "retry": True,
+                         "error": f"part rejected: {exc}"}
+        # hand the VERIFIED wire bytes through: the board spools them
+        # verbatim (no re-serialization, digests lifted from the
+        # already-checked header — partstore.spool)
+        ok = board.submit_part(shard_id, host, segments, raw=raw)
         return 200, {"ok": ok}
 
     def _h_work_spans(self, query, body, ctx=None) -> tuple[int, Any]:
@@ -1073,6 +1115,27 @@ class ApiServer:
         board.report_failure(shard_id, str(body.get("host", "")),
                              str(body.get("error", "worker error")))
         return 200, {"ok": True}
+
+    def _h_work_chaos(self, query, body) -> tuple[int, Any]:
+        """Chaos-injection control channel for the out-of-process
+        harness (bench `_run_crash_resume` drives a SUBPROCESS
+        coordinator, so the in-process `partition_work` /
+        `corrupt_parts` hooks need an HTTP surface). Deliberately NOT
+        behind the partition blackhole — this IS the control channel
+        that opens it."""
+        if self.work is None:
+            raise ApiError(503, "no remote work backend "
+                                "(execution_backend != remote)")
+        applied: dict[str, Any] = {}
+        n = int(body.get("corrupt_parts", 0) or 0)
+        if n > 0:
+            self.corrupt_parts(n)
+            applied["corrupt_parts"] = n
+        seconds = float(body.get("partition_s", 0.0) or 0.0)
+        if seconds > 0:
+            self.partition_work(seconds)
+            applied["partition_s"] = seconds
+        return 200, applied
 
     def _h_work_board(self, query, body) -> tuple[int, Any]:
         return 200, self._work_board_or_503().snapshot()

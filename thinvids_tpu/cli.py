@@ -48,10 +48,17 @@ def run_coordinator(args: argparse.Namespace) -> None:
         from .cluster.remote import RemoteExecutor
         from .farm import CapacityController, NullProvider
 
-        execu = RemoteExecutor(co, args.output_dir, sync=False)
+        # part spool + board checkpoint live beside the job journal
+        # (part_spool_dir overrides): the durable state that lets a
+        # SIGKILLed coordinator resume finished shards from disk
+        # instead of re-encoding the farm's work (cluster/partstore.py)
+        spool = str(get_settings().get("part_spool_dir", "") or "") \
+            or os.path.join(state_dir or args.output_dir, "part-spool")
+        execu = RemoteExecutor(co, args.output_dir, sync=False,
+                               spool_dir=spool)
         work = execu.board
         log.info("remote execution backend: encode shards dispatch to "
-                 "worker daemons via /work")
+                 "worker daemons via /work (part spool at %s)", spool)
         # elastic-farm capacity controller: lifecycle bookkeeping + the
         # claim gate always run; wake/drain/suspend decisions engage
         # when autoscale_enabled is set. The NullProvider only LOGS

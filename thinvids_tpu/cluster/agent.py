@@ -81,16 +81,33 @@ def coordinator_submitter(coordinator) -> Callable[[str, Mapping], None]:
 
 def http_submitter(base_url: str, timeout_s: float = 5.0
                    ) -> Callable[[str, Mapping], None]:
-    """Cross-host heartbeat sink: POST /node_heartbeat on the API."""
+    """Cross-host heartbeat sink: POST /node_heartbeat on the API.
+
+    Transient transport failures (connection refused while a restarted
+    coordinator replays its journal, 5xx) retry with the same
+    jittered-backoff policy as the worker's /work client
+    (`remote_http_retries` / `remote_http_backoff_s`) — one short
+    restart window must not let heartbeat TTLs lapse and sweep healthy
+    workers' leases. A heartbeat is trivially idempotent."""
     import json
     import urllib.request
 
+    from ..core.config import get_settings
+    from ..core.retry import call_with_backoff
+
     def submit(host: str, metrics: Mapping[str, Any]) -> None:
+        snap = get_settings()
         body = json.dumps({"host": host, "metrics": dict(metrics)}).encode()
-        req = urllib.request.Request(
-            base_url.rstrip("/") + "/node_heartbeat", data=body,
-            method="POST", headers={"Content-Type": "application/json"})
-        urllib.request.urlopen(req, timeout=timeout_s).read()
+
+        def send() -> None:
+            req = urllib.request.Request(
+                base_url.rstrip("/") + "/node_heartbeat", data=body,
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=timeout_s).read()
+
+        call_with_backoff(send, int(snap.get("remote_http_retries", 4)),
+                          float(snap.get("remote_http_backoff_s", 0.5)))
     return submit
 
 

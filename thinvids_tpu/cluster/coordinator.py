@@ -324,24 +324,69 @@ class Coordinator:
 
     def recover_jobs(self) -> list[str]:
         """Post-restart adoption: any job the journal shows mid-flight
-        (STARTING/RUNNING/STAMPING) has no live executor — wipe its run
-        state and requeue it, exactly as the reference recovered via
-        scheduler adoption + watchdog + restart_job wipe
+        (STARTING/RUNNING/STAMPING) has no live executor — requeue it,
+        exactly as the reference recovered via scheduler adoption +
+        watchdog + restart_job wipe
         (/root/reference/manager/app.py:1014-1041, 2501-2666). Call once
         after constructing a persistent coordinator. Returns requeued
-        job ids."""
+        job ids.
+
+        With `resume_enabled` (the default) this is the RESUME path,
+        not a restart-from-scratch: the requeue keeps the progress
+        counters visible (`_requeue_for_recovery`) and the new run's
+        executor re-plans deterministically from the durable board
+        checkpoint, rehydrating every shard whose spooled part still
+        verifies (cluster/partstore.py) — a crashed coordinator costs
+        the farm only its in-flight shards, not the finished ones."""
+        resume = bool(self._settings_fn().get("resume_enabled", True))
         requeued = []
         for job in self.store.list():
             if job.status.is_active:
-                self.activity.emit(
-                    "restart", "requeued after coordinator restart "
-                    f"(was {job.status.value})", job_id=job.id)
-                self.restart_job(job.id)
+                if resume:
+                    self.activity.emit(
+                        "restart", "requeued for crash-resume after "
+                        f"coordinator restart (was {job.status.value})",
+                        job_id=job.id)
+                    self._requeue_for_recovery(job.id)
+                else:
+                    self.activity.emit(
+                        "restart", "requeued after coordinator restart "
+                        f"(was {job.status.value})", job_id=job.id)
+                    self.restart_job(job.id)
                 requeued.append(job.id)
         # Jobs persisted while merely WAITING also lost their dispatch
         # trigger in the crash — kick the scheduler regardless.
         self.dispatch_next_waiting_job()
         return requeued
+
+    def _requeue_for_recovery(self, job_id: str) -> None:
+        """Crash-resume requeue: wipe only the run/fencing state and
+        failure attribution; KEEP the progress counters — the resumed
+        run's executor rehydrates completed shards from the part spool
+        and re-reports progress from there, so zeroing parts_done
+        would just flap the dashboard through every recovery."""
+        def apply(j: Job) -> None:
+            if j.status is Status.REJECTED:
+                # same contract as restart_job: recovery re-runs the
+                # pipeline, never admission
+                raise ValueError(
+                    f"job {j.id} was rejected by admission policy; "
+                    f"re-add it to re-evaluate")
+            j.run_token = ""
+            j.heartbeat_at = 0.0
+            j.heartbeat_stage = ""
+            j.heartbeat_host = ""
+            j.heartbeat_note = ""
+            j.failure_stage = ""
+            j.failure_host = ""
+            j.failure_reason = ""
+            j.started_at = 0.0
+            j.finished_at = 0.0
+            j.status = Status.READY
+        self.store.update(job_id, apply)
+        with self._sched_lock:
+            self._active_ids.discard(job_id)
+        self.queue_job(job_id)
 
     def close(self) -> None:
         """Release persistent-state file handles/locks (journal +

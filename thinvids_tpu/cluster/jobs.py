@@ -201,23 +201,48 @@ class JobStore:
     # -- journal -------------------------------------------------------
 
     def _replay_locked(self) -> None:
+        """Replay the journal into `_jobs`. A coordinator SIGKILLed
+        mid-append leaves a torn final line (any byte prefix of the
+        record): the intact prefix replays and the torn tail is
+        physically TRUNCATED — appending after a torn, newline-less
+        tail would weld the next record onto it and lose BOTH. Bad
+        lines in the middle of the file (bit rot) are skipped, never
+        truncated: the records after them are still good."""
         if not os.path.exists(self._path):
             return
-        with open(self._path, encoding="utf-8") as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                    if rec.get("op") == "put":
-                        job = Job.from_dict(rec["job"])
-                        self._jobs[job.id] = job
-                    elif rec.get("op") == "del":
-                        self._jobs.pop(rec.get("id"), None)
-                except Exception:     # noqa: BLE001 - skip the one bad
-                    continue          # record (torn write / bit rot),
+        with open(self._path, "rb") as fh:
+            data = fh.read()
+        pos = 0
+        good_end = 0                  # byte offset after the last
+        while pos < len(data):        # cleanly replayed line
+            nl = data.find(b"\n", pos)
+            end = len(data) if nl < 0 else nl + 1
+            line = data[pos:end].strip()
+            pos = end
+            if not line:
+                good_end = end
+                continue
+            try:
+                rec = json.loads(line)
+                if rec.get("op") == "put":
+                    job = Job.from_dict(rec["job"])
+                    self._jobs[job.id] = job
+                elif rec.get("op") == "del":
+                    self._jobs.pop(rec.get("id"), None)
+            except Exception:         # noqa: BLE001 - skip the one bad
+                continue              # record (torn write / bit rot),
                                       # never abort the whole replay
+            # an unterminated final line that still parses is a record
+            # whose newline alone was lost — accept it, but leave
+            # good_end behind it so the rewrite below re-terminates
+            if nl >= 0:
+                good_end = end
+        if good_end < len(data):
+            # torn tail (or a parsed-but-unterminated last record):
+            # truncate to the last clean boundary; the compaction that
+            # follows construction rewrites live state anyway
+            with open(self._path, "r+b") as fh:
+                fh.truncate(good_end)
 
     def _compact_locked(self) -> None:
         """Rewrite the journal as one put per live job (atomic rename)."""

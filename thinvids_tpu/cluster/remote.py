@@ -38,14 +38,16 @@ two-node farm must not deadlock).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
+import os
 import socket
 import struct
 import threading
 import time
 from typing import TYPE_CHECKING, Any, Callable, Iterable, Mapping
 
-from ..core.status import ShardState
+from ..core.status import ShardState, Status
 from ..core.types import (ChromaFormat, EncodedSegment, GopSpec, SegmentPlan,
                           VideoMeta)
 from ..obs import flight as obs_flight
@@ -53,6 +55,7 @@ from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from .executor import HaltedError, LocalExecutor
 from .jobs import Job
+from .partstore import PartIntegrityError, PartRef, PartStore
 
 if TYPE_CHECKING:
     from .coordinator import Coordinator
@@ -80,7 +83,12 @@ def pack_parts(segments: Iterable[EncodedSegment]) -> bytes:
     directory + concatenated Annex-B payloads. The payload bytes ship
     raw (no base64 inflation) — the part stream IS the scarce resource
     on a farm's uplink, the reason the reference PUT raw chunks at its
-    stitcher (/root/reference/worker/tasks.py:1667-1674)."""
+    stitcher (/root/reference/worker/tasks.py:1667-1674). Every
+    segment record carries its payload's sha256 so a flipped bit on
+    the wire (or later on the spool disk) is rejected at unpack, never
+    stitched silently."""
+    from .partstore import segment_sha256
+
     segments = list(segments)
     header = json.dumps({
         "segments": [{
@@ -90,15 +98,21 @@ def pack_parts(segments: Iterable[EncodedSegment]) -> bytes:
             "idr": s.gop.idr,
             "frame_sizes": list(s.frame_sizes),
             "size": len(s.payload),
+            "sha256": segment_sha256(s.payload),
         } for s in segments],
     }, separators=(",", ":")).encode()
     return b"".join([struct.pack(">I", len(header)), header]
                     + [s.payload for s in segments])
 
 
-def unpack_parts(data: bytes) -> list[EncodedSegment]:
+def unpack_parts(data: bytes, verify: bool = True) -> list[EncodedSegment]:
     """Inverse of :func:`pack_parts`; raises ValueError on torn frames
-    (a truncated upload must not stitch silently)."""
+    (a truncated upload must not stitch silently) and — with `verify`,
+    the default — PartIntegrityError when a payload's sha256 no longer
+    matches its header record (pre-digest frames verify trivially;
+    `part_integrity=False` turns the digest check off)."""
+    from .partstore import PartIntegrityError, segment_sha256
+
     if len(data) < 4:
         raise ValueError("part frame too short")
     hlen = struct.unpack(">I", data[:4])[0]
@@ -113,6 +127,11 @@ def unpack_parts(data: bytes) -> list[EncodedSegment]:
         if len(payload) != size:
             raise ValueError("part payload truncated")
         off += size
+        want = rec.get("sha256")
+        if verify and want and segment_sha256(payload) != str(want):
+            raise PartIntegrityError(
+                f"segment {rec.get('index')} payload does not match "
+                f"its sha256 (corrupt in transfer or storage)")
         segments.append(EncodedSegment(
             gop=GopSpec(index=int(rec["index"]),
                         start_frame=int(rec["start_frame"]),
@@ -164,6 +183,11 @@ class Shard:
     # X-Tvt-Trace header on its /work uploads — a farm job's worker
     # spans land in the SAME coordinator-side trace. "" = unsampled.
     trace_id: str = ""
+    # run-STABLE plan key ("<rung->NNNN"): the durable checkpoint and
+    # spool are keyed by this, not by the run-scoped id, so a resumed
+    # run's fresh token still finds the crashed run's accepted parts
+    # (cluster/partstore.py)
+    key: str = ""
     state: ShardState = ShardState.PENDING
     attempt: int = 0                # completed (failed) attempts so far
     not_before: float = 0.0         # backoff gate for re-claims
@@ -173,6 +197,21 @@ class Shard:
     finished_host: str = ""
     elapsed_s: float = 0.0
     fail_reason: str = ""
+    #: rehydrated DONE from the verified spool on crash-resume (never
+    #: re-encoded this run)
+    resumed: bool = False
+    #: lifetime digest rejections against this shard: transient flips
+    #: requeue free, but past ShardBoard.INTEGRITY_FREE_REJECTS the
+    #: rejection escalates into the normal failure path so a
+    #: deterministic corruption source cannot livelock the job
+    rejects: int = 0
+    #: durable part reference once DONE (partstore.PartRef fields):
+    #: the payload itself lives on the spool disk, not in this record
+    part_path: str = ""
+    part_digests: tuple[str, ...] = ()
+    part_bytes: int = 0
+    #: transient: populated from the spool by take_shards for the
+    #: stitcher; empty while the shard sits DONE on the board
     segments: list[EncodedSegment] = dataclasses.field(default_factory=list)
 
     @property
@@ -236,7 +275,8 @@ class ShardBoard:
     scheduler's admission assumptions intact)."""
 
     def __init__(self, coordinator: "Coordinator",
-                 clock: Callable[[], float] = time.time) -> None:
+                 clock: Callable[[], float] = time.time,
+                 spool_dir: str | None = None) -> None:
         self.coordinator = coordinator
         self._clock = clock
         self._lock = threading.Lock()
@@ -246,6 +286,33 @@ class ShardBoard:
         self._recent: list[dict[str, Any]] = []
         #: lifetime QoS preemptions (ASSIGNED batch shards requeued)
         self._preempted = 0
+        #: lifetime digest rejections (transfer/storage corruption —
+        #: requeued with NO attempt burned) and crash-resume reuses
+        self._integrity_rejects = 0
+        self._resumed = 0
+        #: durable part spool + board checkpoint (partstore.PartStore),
+        #: created lazily so claim-only boards never touch disk. The
+        #: RemoteExecutor passes a STABLE dir (part_spool_dir setting,
+        #: else under its output dir) so a restarted coordinator finds
+        #: the crashed run's parts; unanchored boards (unit tests)
+        #: spool into a private temp dir.
+        self._spool_dir = spool_dir
+        self._parts: PartStore | None = None
+
+    @property
+    def parts(self) -> PartStore:
+        with self._lock:
+            if self._parts is None:
+                root = self._spool_dir
+                if not root:
+                    root = str(self.coordinator._settings_fn().get(
+                        "part_spool_dir", "") or "")
+                if not root:
+                    import tempfile
+
+                    root = tempfile.mkdtemp(prefix="tvt-part-spool-")
+                self._parts = PartStore(root, clock=self._clock)
+            return self._parts
 
     # -- job lifecycle (RemoteExecutor) --------------------------------
 
@@ -264,6 +331,41 @@ class ShardBoard:
                 max_attempts=max_attempts, backoff_s=backoff_s,
                 quarantine_after=quarantine_after, owner_token=token)
             self._order.extend(s.id for s in shards)
+
+    def rehydrate_done(self, shard: Shard, ref: PartRef) -> None:
+        """Crash-resume: mark one freshly planned shard DONE from a
+        VERIFIED spooled part (cluster/partstore.py) before the plan
+        posts to the board — the work is NOT re-encoded and the new
+        run's board entry starts with the crashed run's progress. The
+        PENDING guard makes the edge locally provable (PENDING→DONE is
+        the declared late-part edge: a durable part IS a part that
+        arrived before any lease)."""
+        with self._lock:
+            if shard.state is not ShardState.PENDING:
+                return
+            shard.state = ShardState.DONE
+            shard.segments = []
+            shard.part_path = ref.path
+            shard.part_digests = ref.digests
+            shard.part_bytes = ref.nbytes
+            shard.finished_host = "resume"
+            shard.resumed = True
+            self._resumed += 1
+        obs_metrics.RESUME_SHARDS_REUSED.inc()
+
+    def note_spool_corruption(self, job_id: str, key: str,
+                              reason: str) -> None:
+        """Resume verification found a spooled part that no longer
+        matches its manifest: counted like an ingest digest rejection
+        (the shard simply re-encodes — no attempt burned, the record
+        is retracted by the caller)."""
+        with self._lock:
+            self._integrity_rejects += 1
+        obs_metrics.PART_INTEGRITY_FAILURES.inc()
+        self.coordinator.activity.emit(
+            "integrity",
+            f"spooled part {key} failed its resume digest check; "
+            f"shard will re-encode: {reason}", job_id=job_id)
 
     def cancel_job(self, job_id: str, token: str | None = None) -> None:
         """Drop a job's board state. With `token` set, only the entry
@@ -299,7 +401,14 @@ class ShardBoard:
         tags) and drop its board state. Token-fenced like cancel_job: a
         stale run must not pop the entry a restarted run installed.
         Raises HaltedError when fenced out, RuntimeError if any shard
-        is not DONE (caller raced)."""
+        is not DONE (caller raced).
+
+        Segments load back from the durable spool here — OUTSIDE the
+        board lock — and every payload re-verifies against the digests
+        recorded at accept time (`part_integrity`): a bit that flipped
+        on the spool disk fails the collect (the job fails with
+        attribution and its checkpoint survives for a verified resume)
+        instead of reaching the stitcher."""
         with self._lock:
             entry = self._jobs.get(job_id)
             if entry is None or (token is not None
@@ -315,7 +424,30 @@ class ShardBoard:
                     raise RuntimeError(
                         f"collected shard {shard.id} in state "
                         f"{shard.state.value}")
-            return list(entry.shards.values())
+            shards = list(entry.shards.values())
+        verify = bool(self.coordinator._settings_fn().get(
+            "part_integrity", True))
+        parts = self.parts
+        for shard in shards:
+            if shard.segments or not shard.part_path:
+                continue            # legacy/in-memory record
+            ref = PartRef(job_id=job_id, key=shard.key or shard.id,
+                          path=shard.part_path,
+                          digests=shard.part_digests,
+                          nbytes=shard.part_bytes)
+            try:
+                shard.segments = parts.read_part(ref, verify=verify)
+            except PartIntegrityError as exc:
+                with self._lock:
+                    # keep the snapshot counter in step with the
+                    # Prometheus total: the dashboard/bench read both
+                    self._integrity_rejects += 1
+                obs_metrics.PART_INTEGRITY_FAILURES.inc()
+                raise RuntimeError(
+                    f"shard {shard.id}: spooled part failed its "
+                    f"pre-stitch digest check ({exc}); refusing to "
+                    f"stitch corrupt bytes") from exc
+        return shards
 
     def take_segments(self, job_id: str,
                       token: str | None = None) -> list[EncodedSegment]:
@@ -439,11 +571,19 @@ class ShardBoard:
         return granted
 
     def submit_part(self, shard_id: str, host: str,
-                    segments: list[EncodedSegment]) -> bool:
+                    segments: list[EncodedSegment],
+                    raw: bytes | None = None) -> bool:
         """Accept one encoded part. First result wins: a part from a
         worker whose lease already expired is still accepted while the
         shard is open (the encode is deterministic, so any completed
-        attempt is THE answer); a duplicate after DONE is dropped."""
+        attempt is THE answer); a duplicate after DONE is dropped.
+
+        The payload is streamed to the durable part spool (temp +
+        fsync + atomic rename, digests journaled — partstore.py)
+        BEFORE the shard flips DONE, and the board keeps only the
+        PartRef: a DONE shard pins no payload in coordinator RAM, and
+        a coordinator crash after this call resumes the shard from
+        disk instead of re-encoding it."""
         now = self._clock()
         with self._lock:
             shard = self._find_locked(shard_id)
@@ -455,15 +595,37 @@ class ShardBoard:
                 raise ValueError(
                     f"part for shard {shard_id} covers GOPs {got}, "
                     f"expected {want}")
+            job_id, key = shard.job_id, shard.key or shard.id
+        # spool AND commit (rename + journal fsync) OUTSIDE the board
+        # lock — disk syncs must not stall concurrent claims/sweeps.
+        # Committing before the accept re-check is safe: a done record
+        # the board then refuses is harmless — a same-key duplicate
+        # carries identical bytes (deterministic encode, gop-validated
+        # above), and an orphan from a cancelled entry is reaped by
+        # the next begin_job; on a FAILED shard the record even lets a
+        # later resume rehydrate the finished work.
+        parts = self.parts
+        ref, tmp = parts.spool(job_id, key, segments,
+                               data=bytes(raw) if raw is not None
+                               else None)
+        parts.commit(ref, tmp)
+        with self._lock:
+            shard = self._find_locked(shard_id)
+            if shard is None or not shard.state.is_open \
+                    or shard.job_id != job_id:
+                return False
             shard.state = ShardState.DONE
-            shard.segments = segments
+            shard.segments = []           # the spool holds the bytes
+            shard.part_path = ref.path
+            shard.part_digests = ref.digests
+            shard.part_bytes = ref.nbytes
             shard.finished_host = host
             shard.elapsed_s = now - shard.assigned_at if shard.assigned_at \
                 else 0.0
             self._recent.append({
                 "shard": shard_id, "job_id": shard.job_id, "host": host,
                 "gops": len(shard.gops), "elapsed_s": round(shard.elapsed_s, 3),
-                "bytes": sum(len(s.payload) for s in segments),
+                "bytes": ref.nbytes,
                 "attempt": shard.attempt + 1, "ts": now,
             })
             del self._recent[:-50]
@@ -479,6 +641,56 @@ class ShardBoard:
             host=host, tags={"shard": shard_id, "gops": gops})
         self.coordinator.registry.record_shard_result(host, ok=True)
         return True
+
+    #: digest rejections one shard absorbs for free (requeue, no
+    #: attempt burned) before escalating into the normal failure path:
+    #: a deterministically corrupting link would otherwise
+    #: claim/encode/reject hot-loop forever — the lease never expires
+    #: (each cycle is fast) and the job heartbeat never stalls, so
+    #: nothing else bounds it
+    INTEGRITY_FREE_REJECTS = 4
+
+    def reject_part(self, shard_id: str, host: str, reason: str) -> None:
+        """Digest-mismatch rejection at ingest: a TRANSFER fault, not a
+        worker fault — the lease (when this host still holds it) is
+        handed straight back with NO attempt burned, no backoff and no
+        quarantine accounting (the same semantics as QoS preemption),
+        and the event counts in `tvt_part_integrity_failures_total`.
+        The worker retries the idempotent upload; a re-encode by
+        whoever claims next is the fallback. A shard rejected more
+        than INTEGRITY_FREE_REJECTS times is no longer a transient
+        flip: it escalates through report_failure (attempt burned,
+        backoff, quarantine accounting) so the job eventually FAILS
+        with attribution instead of livelocking."""
+        requeued = False
+        escalate = False
+        with self._lock:
+            self._integrity_rejects += 1
+            shard = self._find_locked(shard_id)
+            if shard is not None and shard.state is ShardState.ASSIGNED \
+                    and shard.assigned_host == host:
+                shard.rejects += 1
+                if shard.rejects > self.INTEGRITY_FREE_REJECTS:
+                    escalate = True     # leave ASSIGNED: the failure
+                                        # path below owns the requeue
+                else:
+                    shard.state = ShardState.PENDING
+                    shard.assigned_host = ""
+                    shard.not_before = 0.0
+                    requeued = True
+        obs_metrics.PART_INTEGRITY_FAILURES.inc()
+        self.coordinator.activity.emit(
+            "integrity",
+            f"part for shard {shard_id} from {host or 'unknown'} "
+            f"rejected on digest mismatch"
+            + (" (lease requeued, no attempt burned)" if requeued
+               else "") + f": {reason}",
+            host=host)
+        if escalate:
+            self.report_failure(
+                shard_id, host,
+                f"persistent part corruption: digest rejected "
+                f"{self.INTEGRITY_FREE_REJECTS + 1}+ times: {reason}")
 
     def report_failure(self, shard_id: str, host: str, error: str) -> None:
         """Worker-reported failure OR lease expiry: requeue with backoff
@@ -708,6 +920,9 @@ class ShardBoard:
                     tc[shard.state.value] += 1
             recent = list(self._recent)
             preempted = self._preempted
+            integrity_rejects = self._integrity_rejects
+            resumed = self._resumed
+            spool = self._parts
         workers = {}
         for w in self.coordinator.registry.all():
             if w.shards_done or w.shards_failed:
@@ -723,7 +938,13 @@ class ShardBoard:
             stats.setdefault("last_shard_s", rec["elapsed_s"])
         return {"shards": counts, "jobs": per_job, "workers": workers,
                 "tenants": tenants, "recent": recent[-20:],
-                "preempted": preempted}
+                "preempted": preempted,
+                # durable-spool health (partstore.py): crash-resume
+                # reuses, digest rejections, bytes spooled on disk
+                "resumed": resumed,
+                "integrity_rejects": integrity_rejects,
+                "spool_bytes": spool.spool_bytes()
+                if spool is not None else 0}
 
 
 class RemoteExecutor(LocalExecutor):
@@ -750,17 +971,40 @@ class RemoteExecutor(LocalExecutor):
     def __init__(self, coordinator, output_dir: str,
                  host: str = "coordinator", sync: bool = False,
                  poll_s: float | None = None,
-                 clock: Callable[[], float] = time.time) -> None:
+                 clock: Callable[[], float] = time.time,
+                 spool_dir: str | None = None) -> None:
         super().__init__(coordinator, output_dir, mesh=None, host=host,
                          sync=sync)
         self._clock = clock
         self.poll_s = poll_s if poll_s is not None else self.POLL_S
-        self.board = ShardBoard(coordinator, clock=clock)
+        # durable part spool + board checkpoint root: the explicit
+        # arg, else the part_spool_dir setting, else a STABLE path
+        # under the output dir — a restarted coordinator must find the
+        # crashed run's parts, so a tempdir would defeat resume
+        if spool_dir is None:
+            snap = coordinator._settings_fn()
+            spool_dir = str(snap.get("part_spool_dir", "") or "") \
+                or os.path.join(output_dir, ".part-spool")
+        self.board = ShardBoard(coordinator, clock=clock,
+                                spool_dir=spool_dir)
         # live deadline breach → requeue this board's ASSIGNED batch
         # shards (cluster/qos.py fires the hook outside its lock)
         qos = getattr(coordinator, "qos", None)
         if qos is not None:
             qos.on_preempt(self.board.preempt_batch)
+
+    def run(self, job: Job) -> None:
+        super().run(job)
+        # release the durable checkpoint once the job's output is
+        # COMMITTED (and only then — a crash between collect and the
+        # mp4 commit must still resume from the spool). Best-effort:
+        # spool hygiene never fails a finished job.
+        try:
+            done = self.coordinator.store.try_get(job.id)
+            if done is not None and done.status is Status.DONE:
+                self.board.parts.clear_job(job.id)
+        except Exception:       # noqa: BLE001 - cleanup only
+            pass
 
     # -- shard planning ------------------------------------------------
 
@@ -819,8 +1063,12 @@ class RemoteExecutor(LocalExecutor):
         run = f"{token[:6]}-" if token else ""
         for i in range(0, plan.num_gops, per_shard):
             gops = plan.gops[i:i + per_shard]
+            # the plan key is run-STABLE (no token): the durable
+            # checkpoint and spool key on it so a resumed run's fresh
+            # token still resolves the crashed run's accepted parts
+            key = f"{tag}{gops[0].index:04d}"
             shards.append(Shard(
-                id=f"{job.id[:12]}-{run}{tag}{gops[0].index:04d}",
+                id=f"{job.id[:12]}-{run}{key}", key=key,
                 job_id=job.id, input_path=job.input_path, meta=meta,
                 gops=tuple(gops), qp=int(qp),
                 gop_frames=int(settings.gop_frames),
@@ -842,6 +1090,156 @@ class RemoteExecutor(LocalExecutor):
         plan = self._plan_remote(num_frames, settings)
         return plan, self._shards_for(job, meta, plan, settings,
                                       qp=int(settings.qp), token=token)
+
+    # -- durable checkpoint / crash-resume (cluster/partstore.py) ------
+
+    @staticmethod
+    def _plan_signature(job: Job, settings, rungs=None) -> str:
+        """Fingerprint of everything that changes a shard's ENCODED
+        BYTES: the input file's identity plus the settings the encode
+        reads. A resumed run whose signature matches may reuse spooled
+        parts verbatim; any drift (operator changed qp, file replaced)
+        resets the checkpoint instead of rehydrating stale bytes."""
+        from ..ingest.watcher import file_signature
+
+        try:
+            fsig = file_signature(job.input_path)
+        except OSError:
+            fsig = "unreadable"
+        fields = [job.input_path, fsig,
+                  getattr(job, "job_type", "transcode"),
+                  str(int(settings.qp)), str(int(settings.gop_frames))]
+        if rungs:
+            fields.extend(f"{r.name}:{r.width}x{r.height}@{r.qp}"
+                          for r in rungs)
+        return hashlib.sha256("|".join(fields).encode()).hexdigest()[:16]
+
+    @staticmethod
+    def _plan_record(sig: str, plan: SegmentPlan,
+                     shards: list[Shard]) -> dict[str, Any]:
+        """JSON-able form of one deterministic shard plan — what the
+        board checkpoint journals so a restarted coordinator re-plans
+        from the RECORD, not from whatever worker count happens to be
+        live at recovery time."""
+        def gop_rows(gops):
+            return [[g.index, g.start_frame, g.num_frames, bool(g.idr)]
+                    for g in gops]
+
+        return {
+            "sig": sig,
+            "gop_frames": int(plan.frames_per_gop),
+            "num_devices": int(plan.num_devices),
+            "plan_gops": gop_rows(plan.gops),
+            "shards": [{
+                "key": s.key, "qp": int(s.qp),
+                "gops": gop_rows(s.gops),
+                "timeout_s": float(s.timeout_s),
+                "rung": s.rung, "rung_width": int(s.rung_width),
+                "rung_height": int(s.rung_height),
+            } for s in shards],
+        }
+
+    def _shards_from_record(self, job: Job, meta, rec: Mapping[str, Any],
+                            settings, token: str
+                            ) -> tuple[SegmentPlan, list[Shard]]:
+        """Rebuild the checkpointed plan under the NEW run token: same
+        plan keys (so done records resolve), fresh run-scoped ids (so
+        the crashed run's in-flight parts still drop — the cross-run
+        fence survives resume)."""
+        from .qos import job_rank
+
+        def gops_of(rows):
+            return tuple(GopSpec(index=int(i), start_frame=int(s),
+                                 num_frames=int(n), idr=bool(idr))
+                         for i, s, n, idr in rows)
+
+        gop_frames = int(rec.get("gop_frames", settings.gop_frames))
+        plan = SegmentPlan(gops=gops_of(rec["plan_gops"]),
+                           num_devices=int(rec.get("num_devices", 1)),
+                           frames_per_gop=gop_frames)
+        priority = job_rank(
+            getattr(job, "job_type", "transcode"),
+            str(settings.get("job_priority", "auto") or "auto"))
+        trace_id = obs_trace.TRACE.trace_id(job.id)
+        run = f"{token[:6]}-" if token else ""
+        shards = []
+        for srec in rec["shards"]:
+            key = str(srec["key"])
+            shards.append(Shard(
+                id=f"{job.id[:12]}-{run}{key}", key=key,
+                job_id=job.id, input_path=job.input_path, meta=meta,
+                gops=gops_of(srec["gops"]), qp=int(srec["qp"]),
+                gop_frames=gop_frames,
+                timeout_s=float(srec["timeout_s"]),
+                rung=str(srec.get("rung", "")),
+                rung_width=int(srec.get("rung_width", 0)),
+                rung_height=int(srec.get("rung_height", 0)),
+                priority=priority, trace_id=trace_id,
+                tenant=getattr(job, "tenant", "default") or "default"))
+        return plan, shards
+
+    def _plan_or_resume(self, job: Job, token: str, settings, meta,
+                        num_frames: int, rungs=None
+                        ) -> tuple[SegmentPlan, list[Shard], int]:
+        """The RESUME path `recover_jobs` grew: when a durable board
+        checkpoint exists for this job and its plan signature still
+        matches, re-plan deterministically FROM the checkpoint, verify
+        every recorded part against its digests, rehydrate the
+        verified ones as DONE under the fresh run token, and leave
+        only the remainder PENDING. Otherwise plan fresh (waiting for
+        the farm as usual) and anchor a new checkpoint. Returns
+        (plan, shards, reused_count)."""
+        co = self.coordinator
+        sig = self._plan_signature(job, settings, rungs=rungs)
+        parts = self.board.parts
+        resume = bool(settings.get("resume_enabled", True))
+        rec: Mapping[str, Any] | None = None
+        if resume:
+            ck = parts.load_job(job.id)
+            if ck is not None and ck.plan.get("sig") == sig \
+                    and ck.plan.get("shards"):
+                rec = ck.plan
+        if rec is not None:
+            plan, shards = self._shards_from_record(job, meta, rec,
+                                                    settings, token)
+        else:
+            self._await_first_workers(job, token, settings)
+            if rungs is None:
+                plan, shards = self._build_shards(job, meta, num_frames,
+                                                  settings, token=token)
+            else:
+                plan = self._plan_remote(num_frames, settings)
+                shards = []
+                for rung in rungs:
+                    shards.extend(self._shards_for(
+                        job, meta, plan, settings, qp=rung.qp,
+                        rung=rung, token=token))
+            rec = self._plan_record(sig, plan, shards)
+        refs = parts.begin_job(job.id, rec)
+        reused = 0
+        if resume:
+            for shard in shards:
+                ref = refs.get(shard.key)
+                if ref is None:
+                    continue
+                if parts.verify_part(ref):
+                    self.board.rehydrate_done(shard, ref)
+                    reused += 1
+                else:
+                    # bit rot / torn spool: retract the record and let
+                    # the shard re-encode — a transfer/storage fault,
+                    # no attempt burned
+                    self.board.note_spool_corruption(
+                        job.id, shard.key, "digest mismatch on the "
+                        "spooled part")
+                    parts.drop_done(job.id, shard.key, ref)
+        if reused:
+            co.activity.emit(
+                "resume",
+                f"crash-resume: {reused}/{len(shards)} shards "
+                f"rehydrated DONE from the verified part spool",
+                job_id=job.id, host=self.host)
+        return plan, shards, reused
 
     # -- encode stage override -----------------------------------------
 
@@ -912,9 +1310,8 @@ class RemoteExecutor(LocalExecutor):
                                        meta, stage)
 
         stage[0] = "segment"
-        self._await_first_workers(job, token, settings)
-        plan, shards = self._build_shards(job, meta, len(frames),
-                                          settings, token=token)
+        plan, shards, reused = self._plan_or_resume(
+            job, token, settings, meta, len(frames))
         co.update_progress(job.id, token, parts_total=plan.num_gops,
                            segment_progress=100.0)
         co.heartbeat_job(
@@ -922,7 +1319,8 @@ class RemoteExecutor(LocalExecutor):
             note=f"{plan.num_gops} GOPs in {len(shards)} shards")
         co.activity.emit(
             "shard", f"dispatching {plan.num_gops} GOPs as "
-            f"{len(shards)} shards to the worker farm",
+            f"{len(shards)} shards to the worker farm"
+            + (f" ({reused} resumed from the spool)" if reused else ""),
             job_id=job.id, host=self.host)
 
         stage[0] = "encode"
@@ -1002,14 +1400,9 @@ class RemoteExecutor(LocalExecutor):
                                           meta, stage)
 
         stage[0] = "segment"
-        self._await_first_workers(job, token, settings)
         rungs = plan_ladder(meta, settings)
-        plan = self._plan_remote(len(frames), settings)
-        shards: list[Shard] = []
-        for rung in rungs:
-            shards.extend(self._shards_for(job, meta, plan, settings,
-                                           qp=rung.qp, rung=rung,
-                                           token=token))
+        plan, shards, reused = self._plan_or_resume(
+            job, token, settings, meta, len(frames), rungs=rungs)
         total_parts = plan.num_gops * len(rungs)
         co.update_progress(job.id, token, parts_total=total_parts,
                            segment_progress=100.0)
@@ -1019,7 +1412,8 @@ class RemoteExecutor(LocalExecutor):
                  f"{len(shards)} shards")
         co.activity.emit(
             "shard", f"dispatching {plan.num_gops} GOPs x {len(rungs)} "
-            f"rungs as {len(shards)} shards to the worker farm",
+            f"rungs as {len(shards)} shards to the worker farm"
+            + (f" ({reused} resumed from the spool)" if reused else ""),
             job_id=job.id, host=self.host)
 
         stage[0] = "encode"
@@ -1104,16 +1498,45 @@ def encode_shard(desc: Mapping[str, Any], frames, mesh=None, tracer=None
 
 
 class WorkerClient:
-    """Minimal stdlib HTTP client for the /work/* routes."""
+    """Minimal stdlib HTTP client for the /work/* routes.
 
-    def __init__(self, base_url: str, timeout_s: float = 30.0) -> None:
+    Every request retries through transient transport failures —
+    connection refused, resets, HTTP 5xx — with jittered exponential
+    backoff (`remote_http_retries` × `remote_http_backoff_s`): a
+    coordinator restart window (a few seconds of refused connections
+    while the journal replays) must not fail shards or quarantine
+    healthy workers. All three verbs are safe to repeat: claims are
+    leases (a lost grant expires into the sweep), part uploads are
+    idempotent via their digests (duplicates drop at the board), and
+    failure reports are absorbing."""
+
+    def __init__(self, base_url: str, timeout_s: float = 30.0,
+                 retries: int | None = None,
+                 backoff_s: float | None = None) -> None:
+        from ..core.config import get_settings
+
         self.base = base_url.rstrip("/")
         self.timeout_s = timeout_s
+        snap = get_settings()
+        self.retries = int(snap.get("remote_http_retries", 4)) \
+            if retries is None else max(0, int(retries))
+        self.backoff_s = float(snap.get("remote_http_backoff_s", 0.5)) \
+            if backoff_s is None else max(0.0, float(backoff_s))
+
+    #: integrity-rejection re-sends per upload, ON TOP of the
+    #: transport retries inside each _request: more than a couple of
+    #: consecutive digest rejects means the corruption is persistent
+    #: and re-encoding (via the requeued lease) is the better path —
+    #: a full retries×retries product would defeat the configured
+    #: bound on how long one upload can mask a dead coordinator
+    INTEGRITY_RESENDS = 2
 
     def _request(self, path: str, data: bytes, content_type: str,
                  timeout_s: float | None = None,
                  trace_id: str = "") -> dict[str, Any]:
         import urllib.request
+
+        from ..core.retry import call_with_backoff
 
         headers = {"Content-Type": content_type}
         if trace_id:
@@ -1122,11 +1545,16 @@ class WorkerClient:
             # validates it against the job's LIVE trace and drops
             # stale-run stragglers
             headers["X-Tvt-Trace"] = trace_id
-        req = urllib.request.Request(
-            self.base + path, data=data, method="POST", headers=headers)
-        with urllib.request.urlopen(
-                req, timeout=timeout_s or self.timeout_s) as resp:
-            return json.loads(resp.read())
+
+        def send() -> dict[str, Any]:
+            req = urllib.request.Request(
+                self.base + path, data=data, method="POST",
+                headers=headers)
+            with urllib.request.urlopen(
+                    req, timeout=timeout_s or self.timeout_s) as resp:
+                return json.loads(resp.read())
+
+        return call_with_backoff(send, self.retries, self.backoff_s)
 
     def claim(self, host: str) -> dict[str, Any] | None:
         out = self._request("/work/claim",
@@ -1136,12 +1564,25 @@ class WorkerClient:
 
     def upload_part(self, shard_id: str, host: str,
                     segments: list[EncodedSegment]) -> bool:
-        out = self._request(
-            f"/work/part/{shard_id}?host={host}", pack_parts(segments),
-            "application/octet-stream",
-            # parts can be large; scale the budget, floor at the default
-            timeout_s=max(self.timeout_s, 120.0))
-        return bool(out.get("ok"))
+        from ..core.retry import sleep_backoff
+
+        data = pack_parts(segments)
+        for attempt in range(self.INTEGRITY_RESENDS + 1):
+            out = self._request(
+                f"/work/part/{shard_id}?host={host}", data,
+                "application/octet-stream",
+                # parts can be large; scale the budget, floor at the
+                # default
+                timeout_s=max(self.timeout_s, 120.0))
+            # digest rejection at ingest ({"retry": true}): the bytes
+            # corrupted in TRANSIT, the lease came straight back with
+            # no attempt burned — re-send the (idempotent) upload
+            # instead of re-encoding the shard
+            if out.get("ok") or not out.get("retry"):
+                return bool(out.get("ok"))
+            if attempt < self.INTEGRITY_RESENDS:
+                sleep_backoff(attempt, self.backoff_s)
+        return False
 
     def upload_spans(self, job_id: str, trace_id: str, host: str,
                      spans: list[dict[str, Any]]) -> int:

@@ -202,6 +202,26 @@ DEFAULT_SETTINGS: dict[str, Any] = {
     "remote_worker_max_failures": 3,  # consecutive failures -> quarantine
     "remote_no_worker_grace_s": 30.0,  # no live workers this long -> job fails
     "remote_claim_poll_s": 1.0,      # worker daemon claim poll interval
+    # durable shard checkpointing + end-to-end part integrity
+    # (cluster/partstore.py): part_spool_dir roots the per-job part
+    # spool and board checkpoint journals (TVT_PART_SPOOL_DIR; "" =
+    # beside the executor's output dir — keep it on the same stable
+    # disk across restarts, or resume finds nothing); part_integrity
+    # (TVT_PART_INTEGRITY) gates the per-segment sha256 verification
+    # at /work ingest, at crash-resume rehydration, and again before
+    # the stitcher reads a spooled part; resume_enabled
+    # (TVT_RESUME_ENABLED) gates the recover_jobs RESUME path —
+    # off restores the restart-from-scratch recovery.
+    "part_spool_dir": "",
+    "part_integrity": True,
+    "resume_enabled": True,
+    # worker HTTP resilience (cluster/remote.WorkerClient): retries ×
+    # jittered exponential backoff on connection-refused/5xx for claim
+    # polls, heartbeats and part uploads, so a coordinator restart
+    # window neither fails shards nor quarantines healthy workers
+    # (TVT_REMOTE_HTTP_RETRIES / TVT_REMOTE_HTTP_BACKOFF_S).
+    "remote_http_retries": 4,
+    "remote_http_backoff_s": 0.5,
 }
 
 _ENV_PREFIX = "TVT_"
@@ -324,6 +344,11 @@ _CLAMPS: dict[str, Callable[[Any], Any]] = {
     # floor: a non-positive poll would busy-spin idle workers against
     # the coordinator's /work/claim
     "remote_claim_poll_s": lambda v: max(0.05, as_float(v, 1.0)),
+    # 0 retries = fail fast (tests); cap bounds how long one upload
+    # can mask a genuinely dead coordinator from the failure path
+    "remote_http_retries": lambda v: min(20, max(0, as_int(v, 4))),
+    "remote_http_backoff_s": lambda v: min(30.0, max(
+        0.05, as_float(v, 0.5))),
     "farm_min_workers": lambda v: min(4096, max(0, as_int(v, 0))),
     "farm_max_workers": lambda v: min(4096, max(0, as_int(v, 0))),
     # floor keeps a drain from force-requeueing leases the instant it

@@ -375,6 +375,20 @@ SHARD_CLAIM_SECONDS = REGISTRY.histogram(
     buckets=(0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
              300.0, 600.0))
 
+# -- durable part spool + crash resume (cluster/partstore.py) -----------
+PART_SPOOL_BYTES = REGISTRY.gauge(
+    "tvt_part_spool_bytes",
+    "bytes of encoded shard parts currently spooled on the "
+    "coordinator's disk (DONE shards hold refs, not payload)")
+PART_INTEGRITY_FAILURES = REGISTRY.counter(
+    "tvt_part_integrity_failures_total",
+    "part payloads rejected on a digest mismatch (transfer/storage "
+    "corruption — requeued with no attempt burned)")
+RESUME_SHARDS_REUSED = REGISTRY.counter(
+    "tvt_crash_resume_shards_reused_total",
+    "shards rehydrated DONE from the verified spool after a "
+    "coordinator restart (work NOT re-encoded)")
+
 # -- split-frame encoding ----------------------------------------------
 SFE_FRAME_SECONDS = REGISTRY.histogram(
     "tvt_sfe_frame_latency_seconds",

@@ -236,6 +236,46 @@ def chaos_defaults(snap=None) -> dict:
     }
 
 
+def flip_part_bit(path: str) -> int:
+    """Bit-flip injection for the crash/corruption chaos tier: flip
+    one bit inside a spooled ``.part`` file's PAYLOAD region (past the
+    4-byte length + JSON header framing, so the flip corrupts encoded
+    bytes rather than tearing the frame). The next digest gate —
+    resume rehydration or the pre-stitch check — must reject the part.
+    Returns the flipped byte offset."""
+    with open(path, "r+b") as fp:
+        data = fp.read()
+        if len(data) < 5:
+            raise ValueError(f"{path}: too short to be a part frame")
+        hlen = int.from_bytes(data[:4], "big")
+        off = min(len(data) - 1, 4 + hlen + max(1, (len(data)
+                                                    - 4 - hlen) // 2))
+        fp.seek(off)
+        fp.write(bytes([data[off] ^ 0x01]))
+    return off
+
+
+def corrupt_spooled_part(spool_root: str, job_id: str) -> str | None:
+    """Corrupt ONE spooled part of `job_id` under `spool_root` (the
+    coordinator's part-spool directory) — the
+    while-the-coordinator-is-down storage rot the crash bench injects.
+    Returns the corrupted path, or None when the job has no spooled
+    parts."""
+    import os
+
+    sdir = os.path.join(spool_root, job_id)
+    try:
+        victims = sorted(f for f in os.listdir(sdir)
+                         if f.endswith(".part"))
+    except OSError:
+        return None
+    if not victims:
+        return None
+    path = os.path.join(sdir, victims[0])
+    flip_part_bit(path)
+    return path
+
+
 def diurnal_rate(t_s: float, period_s: float, lo_rps: float,
                  hi_rps: float) -> float:
     """Sinusoidal day curve: submission rate at time `t_s` into the
