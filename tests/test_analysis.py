@@ -761,6 +761,10 @@ class TestBoardModel:
         ("resume_burns_attempt", "attempt-accounting"),
         ("ingest_no_verify", "part-integrity"),
         ("stitch_no_verify", "part-integrity"),
+        # band-group lockstep restart (farm SFE, ISSUE 14): a restart
+        # that requeues a DONE sibling WITHOUT retracting its spooled
+        # part re-leases work the spool already holds
+        ("band_restart_keeps_spool", "resume-reuse"),
     ])
     def test_seeded_mutation_yields_counterexample(self, mutation,
                                                    invariant):

@@ -1,0 +1,819 @@
+"""Farm split-frame encoding (ISSUE 14): band-shaped shards, the halo
+relay, and cross-host bit-identity.
+
+- wire/unit tiers: halo blob framing + digest rejection, relay
+  generation fencing + ring eviction, band descriptor wire form (and
+  the unchanged GOP form), unsupported-shape requeue with no attempt
+  burn, the band-count clamp against the slowest worker's devices,
+  claim affinity scoring, band-group lockstep restart, and the
+  band-slice stitcher;
+- `test_two_group_farm_bit_identical_to_local_mesh`: two in-process
+  band slices (one device each) exchanging halo/probe/histogram
+  through a real HaloRelay reproduce the local-mesh 2-band SFE stream
+  byte for byte;
+- `test_farm_sfe_end_to_end_two_workers`: the hermetic acceptance test
+  — subprocess coordinator + 2 single-device worker daemons encode ONE
+  stream as band shards over HTTP (halo via /work/halo), and the
+  stitched MP4 is BYTE-identical to a local-mesh SFE encode; the job's
+  trace carries both workers' band spans under one trace id.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from thinvids_tpu.cluster import Coordinator, WorkerRegistry
+from thinvids_tpu.cluster.executor import LocalExecutor
+from thinvids_tpu.cluster.halo import (HaloRelay, HaloSession,
+                                       HaloStaleError, LocalHaloHub,
+                                       pack_arrays, unpack_arrays)
+from thinvids_tpu.cluster.remote import (RemoteExecutor, Shard,
+                                         ShardBoard, stitch_band_shards)
+from thinvids_tpu.core.config import DEFAULT_SETTINGS, Settings
+from thinvids_tpu.core.status import ShardState, Status
+from thinvids_tpu.core.types import (EncodedSegment, Frame, GopSpec,
+                                     VideoMeta)
+from thinvids_tpu.io.y4m import write_y4m
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_settings(**over):
+    values = dict(DEFAULT_SETTINGS)
+    values.update(over)
+    return Settings(values=values)
+
+
+def clip_frames(w=64, h=48, n=16):
+    yy, xx = np.mgrid[0:h, 0:w]
+    return [Frame(
+        y=((xx * 2 + yy + 7 * i) % 256).astype(np.uint8),
+        u=np.full((h // 2, w // 2), 108, np.uint8),
+        v=np.full((h // 2, w // 2), 148, np.uint8),
+    ) for i in range(n)]
+
+
+def write_clip(path, w=64, h=48, n=16):
+    meta = VideoMeta(width=w, height=h, fps_num=30, fps_den=1,
+                     num_frames=n)
+    write_y4m(str(path), meta, clip_frames(w, h, n))
+    return meta
+
+
+def make_board(workers=("w1", "w2"), devices=2, **over):
+    """Board + coordinator with claim-capable workers. `devices` may
+    be an int (every worker) or a {host: count} map — the claim's
+    band-width gate reads the advertised worker_devices."""
+    snap = make_settings(pipeline_worker_count=len(workers) + 1, **over)
+    reg = WorkerRegistry()
+    for hostname in workers:
+        n = devices.get(hostname, 1) if isinstance(devices, dict)             else devices
+        reg.heartbeat(hostname, metrics={"worker": True,
+                                         "worker_devices": n})
+    coord = Coordinator(registry=reg, settings_fn=lambda: snap)
+    return ShardBoard(coord), coord
+
+
+def band_shard(sid, lo, hi, total=2, job_id="j0", ngops=2,
+               input_path="/in/a.y4m", gop0=0):
+    gops = tuple(GopSpec(index=gop0 + i, start_frame=2 * (gop0 + i),
+                         num_frames=2) for i in range(ngops))
+    return Shard(id=sid, job_id=job_id, input_path=input_path,
+                 meta=VideoMeta(width=64, height=48), gops=gops, qp=30,
+                 gop_frames=2, timeout_s=60.0, shape="band",
+                 band_start=lo, band_count=hi - lo, total_bands=total,
+                 halo_rows=32, key=f"band{lo:03d}")
+
+
+def gop_shard(sid="j0-0000", job_id="j0", gop0=0, ngops=2,
+              input_path="/in/a.y4m"):
+    gops = tuple(GopSpec(index=gop0 + i, start_frame=2 * (gop0 + i),
+                         num_frames=2) for i in range(ngops))
+    return Shard(id=sid, job_id=job_id, input_path=input_path,
+                 meta=VideoMeta(width=64, height=48), gops=gops, qp=30,
+                 gop_frames=2, timeout_s=60.0)
+
+
+# ---------------------------------------------------------------------------
+# halo framing + relay
+# ---------------------------------------------------------------------------
+
+
+class TestHaloFraming:
+    def test_roundtrip(self):
+        arrays = {"y": np.arange(64, dtype=np.int16).reshape(8, 8),
+                  "n": np.asarray([7], np.int64)}
+        out = unpack_arrays(pack_arrays(arrays))
+        assert set(out) == {"y", "n"}
+        np.testing.assert_array_equal(out["y"], arrays["y"])
+        assert out["y"].dtype == np.int16
+        assert int(out["n"][0]) == 7
+
+    def test_flipped_bit_rejected(self):
+        blob = bytearray(pack_arrays(
+            {"y": np.arange(64, dtype=np.int16)}))
+        blob[-3] ^= 0x10                # payload byte, not the header
+        with pytest.raises(ValueError, match="sha256"):
+            unpack_arrays(bytes(blob))
+
+    def test_truncated_rejected(self):
+        blob = pack_arrays({"y": np.arange(64, dtype=np.int16)})
+        with pytest.raises(ValueError):
+            unpack_arrays(blob[:-1])
+
+
+class TestHaloRelay:
+    def test_post_wait_roundtrip(self):
+        relay = HaloRelay()
+        relay.set_gen("j", 1)
+        assert relay.post("j", 1, 0, 0, "top", b"abc")
+        assert relay.wait("j", 1, 0, 0, "top", 0.1) == b"abc"
+
+    def test_unknown_job_is_stale_not_resurrected(self):
+        """Straggler traffic after clear_job (or against a bogus job
+        id) must answer `stale`, never recreate an entry — a cleared
+        job's blobs would otherwise leak on the coordinator forever."""
+        relay = HaloRelay()
+        assert not relay.post("ghost", 1, 0, 0, "top", b"x")
+        with pytest.raises(HaloStaleError):
+            relay.wait("ghost", 1, 0, 0, "top", 0.0)
+        relay.set_gen("j", 1)
+        relay.post("j", 1, 0, 0, "top", b"x")
+        relay.clear_job("j")
+        assert not relay.post("j", 1, 0, 1, "top", b"y")
+        with pytest.raises(HaloStaleError):
+            relay.wait("j", 1, 0, 0, "top", 0.0)
+        assert relay.snapshot()["jobs"] == 0
+
+    def test_wait_blocks_until_post(self):
+        relay = HaloRelay()
+        relay.set_gen("j", 1)
+
+        def later():
+            time.sleep(0.1)
+            relay.post("j", 1, 5, 1, "bot", b"xyz")
+
+        threading.Thread(target=later, daemon=True).start()
+        assert relay.wait("j", 1, 5, 1, "bot", 5.0) == b"xyz"
+
+    def test_stale_generation_fenced(self):
+        relay = HaloRelay()
+        relay.set_gen("j", 1)
+        relay.post("j", 1, 0, 0, "top", b"old")
+        relay.set_gen("j", 2)
+        # stale post refused; stale wait raises; the old blob is gone
+        assert not relay.post("j", 1, 0, 0, "top", b"old")
+        with pytest.raises(HaloStaleError):
+            relay.wait("j", 1, 0, 0, "top", 0.1)
+        assert relay.wait("j", 2, 0, 0, "top", 0.05) is None
+
+    def test_ring_evicts_old_frames_per_stream(self):
+        relay = HaloRelay()
+        relay.set_gen("j", 1)
+        for seq in range(HaloRelay.RING + 4):
+            relay.post("j", 1, seq, 0, "top", bytes([seq]))
+        # the oldest frames fell off the ring; the newest survive
+        assert relay.wait("j", 1, 0, 0, "top", 0.0) is None
+        last = HaloRelay.RING + 3
+        assert relay.wait("j", 1, last, 0, "top", 0.0) == bytes([last])
+        # an unrelated stream is untouched
+        relay.post("j", 1, 0, 1, "top", b"z")
+        assert relay.wait("j", 1, 0, 1, "top", 0.0) == b"z"
+
+
+# ---------------------------------------------------------------------------
+# descriptor wire forms + board protocol
+# ---------------------------------------------------------------------------
+
+
+class TestBandDescriptor:
+    def test_gop_shard_wire_form_unchanged(self):
+        """Rolling-upgrade compat: a GOP-range shard's descriptor must
+        not grow a shape tag (old workers key on the exact fields)."""
+        desc = gop_shard().descriptor()
+        assert "shape" not in desc
+        assert "band" not in desc
+
+    def test_band_shard_wire_form(self):
+        desc = band_shard("j0-b0", 0, 1).descriptor()
+        assert desc["shape"] == "band"
+        assert desc["band"]["start"] == 0
+        assert desc["band"]["count"] == 1
+        assert desc["band"]["total"] == 2
+        assert desc["band"]["halo_rows"] == 32
+
+    def test_claim_fills_groups_and_generation(self):
+        board, _ = make_board()
+        board.add_job("j0", [band_shard("j0-b0", 0, 1),
+                             band_shard("j0-b1", 1, 2)],
+                      max_attempts=3, backoff_s=0.1, quarantine_after=3)
+        desc = board.claim("w1")
+        assert desc is not None and desc["shape"] == "band"
+        assert desc["band"]["groups"] == [[0, 1], [1, 2]]
+        assert desc["band"]["gen"] == 1
+
+    def test_unknown_shape_rejected_by_worker(self):
+        from thinvids_tpu.cluster.remote import (UnsupportedShardShape,
+                                                 encode_shard)
+
+        desc = gop_shard().descriptor()
+        desc["shape"] = "hologram"
+        with pytest.raises(UnsupportedShardShape):
+            encode_shard(desc, [])
+
+
+class TestUnsupportedRequeue:
+    def test_requeue_burns_no_attempt_and_excludes_host(self):
+        board, _ = make_board(workers=("w1", "w2"))
+        shard = band_shard("j0-b0", 0, 2)
+        board.add_job("j0", [shard], max_attempts=3, backoff_s=5.0,
+                      quarantine_after=3)
+        desc = board.claim("w1")
+        assert desc["id"] == "j0-b0"
+        board.report_unsupported("j0-b0", "w1", "unknown shape")
+        assert shard.state is ShardState.PENDING
+        assert shard.attempt == 0          # NO attempt burned
+        assert shard.not_before == 0.0     # no backoff either
+        assert "w1" in shard.no_hosts
+        # w1 never gets it again; w2 does
+        assert board.claim("w1") is None
+        desc2 = board.claim("w2")
+        assert desc2 is not None and desc2["id"] == "j0-b0"
+
+    def test_unsupported_over_http_work_status(self, tmp_path):
+        from thinvids_tpu.api.server import ApiServer
+
+        board, coord = make_board()
+        shard = band_shard("j0-b0", 0, 2)
+        board.add_job("j0", [shard], max_attempts=3, backoff_s=5.0,
+                      quarantine_after=3)
+        api = ApiServer(coord, work=board).start()
+        try:
+            assert board.claim("w1")["id"] == "j0-b0"
+            req = urllib.request.Request(
+                api.url + "/work/status",
+                data=json.dumps({"shard_id": "j0-b0", "host": "w1",
+                                 "ok": False, "unsupported": True,
+                                 "error": "unknown shape"}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                assert json.loads(resp.read())["ok"]
+            assert shard.state is ShardState.PENDING
+            assert shard.attempt == 0
+            assert "w1" in shard.no_hosts
+        finally:
+            api.stop()
+
+
+class TestBandGroupRestart:
+    def test_sibling_requeue_no_attempt_burn_and_gen_bump(self):
+        board, _ = make_board()
+        s0, s1 = band_shard("j0-b0", 0, 1), band_shard("j0-b1", 1, 2)
+        board.add_job("j0", [s0, s1], max_attempts=3, backoff_s=0.1,
+                      quarantine_after=5)
+        assert board.claim("w1")["id"] == "j0-b0"
+        assert board.claim("w2")["id"] == "j0-b1"
+        board.report_failure("j0-b0", "w1", "device fell over")
+        # the failed shard burned ITS attempt; the stranded sibling
+        # requeued for free (preemption semantics)
+        assert s0.state is ShardState.PENDING and s0.attempt == 1
+        assert s1.state is ShardState.PENDING and s1.attempt == 0
+        # the halo epoch moved on: stale posts refuse
+        assert not board.halo.post("j0", 1, 0, 0, "top", b"x")
+        with pytest.raises(HaloStaleError):
+            board.halo.wait("j0", 1, 0, 0, "top", 0.0)
+
+    def test_done_sibling_requeued_with_part_retracted(self):
+        """Code-review regression: a band shard that finished BEFORE a
+        sibling failed must rejoin the restart — its worker is gone,
+        so the re-encoding sibling would otherwise block on halo rows
+        nobody will ever send, time out, and burn the job's budget.
+        The DONE shard requeues with NO attempt burned and its spooled
+        part retracted (the model-checked DONE→PENDING edge)."""
+        board, _ = make_board()
+        s0, s1 = band_shard("j0-b0", 0, 1), band_shard("j0-b1", 1, 2)
+        board.add_job("j0", [s0, s1], max_attempts=3, backoff_s=0.1,
+                      quarantine_after=5)
+        assert board.claim("w1")["id"] == "j0-b0"
+        assert board.claim("w2")["id"] == "j0-b1"
+        segs = [EncodedSegment(
+            gop=GopSpec(index=i, start_frame=2 * i, num_frames=2),
+            payload=b"\0\0\1x", frame_sizes=(4,)) for i in range(2)]
+        assert board.submit_part("j0-b0", "w1", segs)
+        assert s0.state is ShardState.DONE and s0.part_path
+        board.report_failure("j0-b1", "w2", "worker died")
+        assert s1.state is ShardState.PENDING and s1.attempt == 1
+        # the finished sibling rejoined the lockstep restart
+        assert s0.state is ShardState.PENDING
+        assert s0.attempt == 0             # retraction burns nothing
+        assert s0.part_path == "" and not s0.segments
+        # and both are claimable again (fresh generation; the failed
+        # shard's backoff gate may still be ticking — only the
+        # retracted sibling must be immediately claimable)
+        assert board.claim("w1")["id"] == "j0-b0"
+
+    def test_undersized_worker_never_claims_wide_band_shard(self):
+        """Code-review regression: a worker with fewer devices than a
+        band shard's band_count must never be offered it — the encode
+        would fail, burn an attempt and restart the whole group."""
+        board, coord = make_board(workers=("small", "big"),
+                                  devices={"small": 1, "big": 4})
+        wide = band_shard("j0-b0", 0, 2)   # band_count=2
+        board.add_job("j0", [wide], max_attempts=3, backoff_s=0.1,
+                      quarantine_after=5)
+        assert board.claim("small") is None
+        desc = board.claim("big")
+        assert desc is not None and desc["id"] == "j0-b0"
+
+    def test_gop_shards_unaffected(self):
+        board, _ = make_board()
+        s0 = gop_shard("j0-0000", gop0=0)
+        s1 = gop_shard("j0-0002", gop0=2)
+        board.add_job("j0", [s0, s1], max_attempts=3, backoff_s=0.1,
+                      quarantine_after=5)
+        assert board.claim("w1")
+        assert board.claim("w2")
+        board.report_failure(s0.id, "w1", "boom")
+        assert s1.state is ShardState.ASSIGNED   # no group semantics
+
+
+class TestClaimAffinity:
+    def test_prefers_continuing_the_hosts_cached_input(self):
+        board, _ = make_board(workers=("w1",))
+        b0 = gop_shard("j-b0", job_id="j", gop0=0, input_path="/in/b.y4m")
+        a0 = gop_shard("j-a0", job_id="j", gop0=0, input_path="/in/a.y4m")
+        b1 = gop_shard("j-b1", job_id="j", gop0=2, input_path="/in/b.y4m")
+        board.add_job("j", [b0, a0, b1], max_attempts=3, backoff_s=0.1,
+                      quarantine_after=5)
+        # first claim: FIFO (no affinity yet) → b0
+        assert board.claim("w1")["id"] == "j-b0"
+        # b1 CONTINUES b0's frame range on the same input: preferred
+        # over the earlier-queued a0 (cold open)
+        assert board.claim("w1")["id"] == "j-b1"
+        assert board.claim("w1")["id"] == "j-a0"
+
+    def test_affinity_never_overrides_priority(self):
+        board, _ = make_board(workers=("w1",))
+        batch = gop_shard("j-b0", job_id="j", gop0=0,
+                          input_path="/in/b.y4m")
+        board.add_job("j", [batch], max_attempts=3, backoff_s=0.1,
+                      quarantine_after=5)
+        assert board.claim("w1")["id"] == "j-b0"
+        live = gop_shard("j2-l0", job_id="j2", gop0=0,
+                         input_path="/in/live.y4m")
+        live.priority = 0
+        cont = gop_shard("j-b1", job_id="j", gop0=2,
+                         input_path="/in/b.y4m")
+        board.add_job("j2", [live], max_attempts=3, backoff_s=0.1,
+                      quarantine_after=5)
+        board.add_job("j3", [cont], max_attempts=3, backoff_s=0.1,
+                      quarantine_after=5)
+        # live-class work beats the affinity-perfect batch continuation
+        assert board.claim("w1")["id"] == "j2-l0"
+
+
+# ---------------------------------------------------------------------------
+# planner + clamp + stitcher
+# ---------------------------------------------------------------------------
+
+
+class TestBandPlanning:
+    def test_plan_band_groups_partition(self):
+        from thinvids_tpu.parallel.planner import plan_band_groups
+
+        assert plan_band_groups(4, 2) == ((0, 2), (2, 4))
+        assert plan_band_groups(5, 2) == ((0, 3), (3, 5))
+        assert plan_band_groups(2, 8) == ((0, 1), (1, 2))
+        # pure function: same inputs, same partition
+        assert plan_band_groups(7, 3) == plan_band_groups(7, 3)
+
+    def test_plan_encode_band_record_roundtrip(self):
+        from thinvids_tpu.parallel.planner import plan_encode
+
+        snap = make_settings(gop_frames=4, sfe_bands=4)
+        plan = plan_encode(32, snap, num_devices=2, shape="band",
+                           total_bands=4, group_count=2, mb_height=8)
+        assert plan.shape == "band"
+        assert plan.total_bands == 4
+        assert plan.band_groups == ((0, 2), (2, 4))
+        rec = plan.record()
+        assert rec["shape"] == "band" and rec["total_bands"] == 4
+
+    def test_remote_clamps_bands_to_slowest_worker(self, tmp_path):
+        """Satellite fix: band shards must never plan more bands per
+        shard than the SLOWEST worker's device count — clamp + WARN up
+        front, never a mid-job fallback."""
+        snap = make_settings(sfe_bands=16, gop_frames=2,
+                             heartbeat_throttle_s=0.0,
+                             pipeline_worker_count=3)
+        reg = WorkerRegistry()
+        reg.heartbeat("w1", metrics={"worker": True,
+                                     "worker_devices": 4})
+        reg.heartbeat("w2", metrics={"worker": True,
+                                     "worker_devices": 1})   # slowest
+        coord = Coordinator(registry=reg, settings_fn=lambda: snap)
+        execu = RemoteExecutor(coord, output_dir=str(tmp_path / "lib"),
+                               sync=True)
+
+        class FakeJob:
+            id = "job0000000000"
+            input_path = str(tmp_path / "x.y4m")
+            job_type = "transcode"
+            tenant = "default"
+
+        meta = VideoMeta(width=64, height=256)   # 16 MB rows
+        plan, shards = execu._build_band_shards(FakeJob(), meta, 16,
+                                                snap, token="tok123")
+        # 2 workers x min(4, 1) device = 2 bands, one slice each
+        assert len(shards) == 2
+        assert all(s.band_count == 1 for s in shards)
+        assert shards[0].total_bands == 2
+        assert any("clamped to 2" in e["message"]
+                   for e in coord.activity.fetch(50))
+
+    def test_checkpoint_record_restores_band_shape(self, tmp_path):
+        """PR 13 crash-resume: the durable plan record covers the band
+        shape, so a restarted coordinator re-plans the IDENTICAL band
+        layout from the checkpoint — independent of the worker count
+        live at recovery time."""
+        snap = make_settings(sfe_bands=2, gop_frames=2,
+                             heartbeat_throttle_s=0.0)
+        reg = WorkerRegistry()
+        for hostname in ("w1", "w2"):
+            reg.heartbeat(hostname, metrics={"worker": True,
+                                             "worker_devices": 1})
+        coord = Coordinator(registry=reg, settings_fn=lambda: snap)
+        execu = RemoteExecutor(coord, output_dir=str(tmp_path / "lib"),
+                               sync=True)
+
+        class FakeJob:
+            id = "job0000000000"
+            input_path = str(tmp_path / "x.y4m")
+            job_type = "transcode"
+            tenant = "default"
+
+        meta = VideoMeta(width=64, height=96)
+        plan, shards = execu._build_band_shards(FakeJob(), meta, 8,
+                                                snap, token="aaaaaa")
+        rec = execu._plan_record("sig0", plan, shards)
+        restored_plan, restored = execu._shards_from_record(
+            FakeJob(), meta, rec, snap, token="bbbbbb")
+        assert [s.key for s in restored] == [s.key for s in shards]
+        for a, b in zip(shards, restored):
+            assert (b.shape, b.band_start, b.band_count,
+                    b.total_bands, b.halo_rows) == \
+                   (a.shape, a.band_start, a.band_count,
+                    a.total_bands, a.halo_rows)
+            assert b.gops == a.gops
+            # fresh run token → fresh run-scoped ids, same stable keys
+            assert b.id != a.id
+
+    def test_stitch_band_shards_zips_frames(self):
+        def seg(idx, frames):
+            return EncodedSegment(
+                gop=GopSpec(index=idx, start_frame=2 * idx,
+                            num_frames=len(frames)),
+                payload=b"".join(frames),
+                frame_sizes=tuple(len(f) for f in frames))
+
+        s0 = band_shard("b0", 0, 1)
+        s0.segments = [seg(0, [b"A0", b"A1"]), seg(1, [b"A2", b"A3"])]
+        s1 = band_shard("b1", 1, 2)
+        s1.segments = [seg(0, [b"b0x", b"b1x"]), seg(1, [b"b2x", b"b3x"])]
+        out = stitch_band_shards([s1, s0])    # order-insensitive input
+        assert [s.gop.index for s in out] == [0, 1]
+        assert out[0].payload == b"A0b0xA1b1x"
+        assert out[0].frame_sizes == (5, 5)
+        assert out[1].payload == b"A2b2xA3b3x"
+
+    def test_stitch_rejects_frame_count_mismatch(self):
+        s0 = band_shard("b0", 0, 1)
+        s0.segments = [EncodedSegment(
+            gop=GopSpec(index=0, start_frame=0, num_frames=2),
+            payload=b"XY", frame_sizes=(1, 1))]
+        s1 = band_shard("b1", 1, 2)
+        s1.segments = [EncodedSegment(
+            gop=GopSpec(index=0, start_frame=0, num_frames=2),
+            payload=b"X", frame_sizes=(1,))]
+        with pytest.raises(ValueError, match="frame count"):
+            stitch_band_shards([s0, s1])
+
+
+# ---------------------------------------------------------------------------
+# cross-host bit-identity
+# ---------------------------------------------------------------------------
+
+
+class TestFarmBitIdentity:
+    def test_two_group_farm_bit_identical_to_local_mesh(self):
+        """Two band slices on SEPARATE single-device meshes, lockstep
+        through a real HaloRelay (every exchange code path except the
+        HTTP hop), emit slice streams whose per-frame zip equals the
+        local-mesh 2-band SFE stream byte for byte."""
+        import jax
+        from jax.sharding import Mesh
+
+        from thinvids_tpu.core.types import concat_segments
+        from thinvids_tpu.parallel.dispatch import SfeShardEncoder
+        from thinvids_tpu.parallel.sfefarm import FarmBandEncoder
+
+        w, h, n, qp, gf = 192, 128, 6, 27, 3
+        frames = clip_frames(w, h, n)
+        meta = VideoMeta(width=w, height=h, fps_num=30, fps_den=1,
+                         num_frames=n)
+        ref = SfeShardEncoder(meta, qp=qp, gop_frames=gf, bands=2)
+        want = concat_segments(ref.encode(frames))
+
+        relay = HaloRelay()
+        relay.set_gen("job", 1)
+        groups = [(0, 1), (1, 2)]
+        outs, errs = {}, []
+
+        def run(lo, hi, dev):
+            try:
+                mesh = Mesh(np.array([jax.devices()[dev]]), ("band",))
+                sess = HaloSession(
+                    LocalHaloHub(relay, "job", 1, timeout_s=120.0),
+                    band_lo=lo, band_hi=hi, groups=groups)
+                enc = FarmBandEncoder(meta, qp=qp, mesh=mesh,
+                                      gop_frames=gf, total_bands=2,
+                                      band_range=(lo, hi), session=sess)
+                outs[lo] = enc.encode(frames)
+            except Exception as exc:    # noqa: BLE001 - surfaced below
+                errs.append(exc)
+
+        ts = [threading.Thread(target=run, args=(0, 1, 0)),
+              threading.Thread(target=run, args=(1, 2, 1))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(300)
+        assert not errs, errs
+        s0 = band_shard("b0", 0, 1, job_id="job", ngops=0)
+        s0.segments = outs[0]
+        s1 = band_shard("b1", 1, 2, job_id="job", ngops=0)
+        s1.segments = outs[1]
+        got = concat_segments(stitch_band_shards([s0, s1]))
+        assert got == want
+
+
+# ---------------------------------------------------------------------------
+# live: farm catch-up + banded edge
+# ---------------------------------------------------------------------------
+
+
+def _board_worker(board, host, stop):
+    """Fake worker thread: claims straight off the board (no HTTP) and
+    encodes with the real shard executor."""
+    from thinvids_tpu.cluster.remote import encode_shard
+    from thinvids_tpu.ingest.decode import read_video
+
+    cache = {}
+
+    def loop():
+        while not stop.is_set():
+            desc = board.claim(host)
+            if desc is None:
+                time.sleep(0.01)
+                continue
+            path = desc["input_path"]
+            if path not in cache:
+                cache[path] = read_video(path)[1]
+            segs = encode_shard(desc, cache[path])
+            board.submit_part(desc["id"], host, segs)
+
+    t = threading.Thread(target=loop, daemon=True,
+                         name=f"fake-worker-{host}")
+    t.start()
+    return t
+
+
+def _tree_bytes(root):
+    out = {}
+    for dirpath, _dirs, files in os.walk(root):
+        for f in files:
+            p = os.path.join(dirpath, f)
+            with open(p, "rb") as fp:
+                out[os.path.relpath(p, root)] = fp.read()
+    return out
+
+
+class TestLiveFarm:
+    def _live_clip(self, tmp_path, name, n=24, gop=3):
+        d = tmp_path / name
+        d.mkdir()
+        path = d / "clip.live.y4m"
+        meta = write_clip(path, w=64, h=48, n=n)
+        # complete source + explicit end-of-stream marker: the tail
+        # sees the whole backlog at once (the catch-up scenario) and
+        # ends without the stall timeout
+        (d / "clip.live.y4m.eos").write_text("")
+        return str(path), meta
+
+    def test_live_catchup_fans_across_farm_byte_identical(self,
+                                                          tmp_path):
+        """A live job under the remote backend farms its backlog GOPs
+        across workers while the newest GOP encodes locally — and the
+        served tree is byte-identical to the all-local live run."""
+        path_l, meta = self._live_clip(tmp_path, "local")
+        snap = make_settings(gop_frames=3, qp=30, ladder_rungs="24",
+                             segment_s=0.2, dvr_window_s=0.0,
+                             live_stall_s=5.0, heartbeat_throttle_s=0.0,
+                             pipeline_worker_count=3)
+        reg = WorkerRegistry()
+        for i in range(8):
+            reg.heartbeat(f"ref{i}")
+        coord_l = Coordinator(registry=reg, settings_fn=lambda: snap)
+        exec_l = LocalExecutor(coord_l,
+                               output_dir=str(tmp_path / "lib_l"),
+                               sync=True)
+        coord_l._launcher = exec_l.launch
+        job_l = coord_l.add_job(path_l, meta)
+        job_l = coord_l.store.get(job_l.id)
+        assert job_l.status is Status.DONE, job_l.failure_reason
+        want = _tree_bytes(str(tmp_path / "lib_l" / "clip.live.hls"))
+
+        path_r, meta = self._live_clip(tmp_path, "remote")
+        reg_r = WorkerRegistry()
+        for hostname in ("fw1", "fw2"):
+            reg_r.heartbeat(hostname, metrics={"worker": True,
+                                               "worker_devices": 1})
+        coord_r = Coordinator(registry=reg_r, settings_fn=lambda: snap)
+        exec_r = RemoteExecutor(coord_r,
+                                output_dir=str(tmp_path / "lib_r"),
+                                sync=False, poll_s=0.05)
+        coord_r._launcher = exec_r.launch
+        stop = threading.Event()
+        try:
+            for hostname in ("fw1", "fw2"):
+                _board_worker(exec_r.board, hostname, stop)
+            job_r = coord_r.add_job(path_r, meta)
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                st = coord_r.store.get(job_r.id)
+                if st.status in (Status.DONE, Status.FAILED):
+                    break
+                time.sleep(0.1)
+        finally:
+            stop.set()
+        st = coord_r.store.get(job_r.id)
+        assert st.status is Status.DONE, st.failure_reason
+        # the farm actually took catch-up shards
+        events = [e["message"] for e in coord_r.activity.fetch(200)]
+        assert any("live catch-up" in m for m in events), events
+        got = _tree_bytes(str(tmp_path / "lib_r" / "clip.live.hls"))
+        assert set(got) == set(want)
+        diff = [k for k in want if got[k] != want[k]]
+        assert not diff, f"live tree diverged at {diff}"
+
+    def test_live_sfe_edge_single_rung(self, tmp_path):
+        """`sfe_bands > 0` + a single-rung stream runs the live edge
+        through the split-frame encoder (per-frame banded stepping) —
+        the job completes and serves a playable tree."""
+        path, meta = self._live_clip(tmp_path, "sfe", n=12)
+        snap = make_settings(gop_frames=3, qp=30, ladder_rungs="48",
+                             segment_s=0.2, dvr_window_s=0.0,
+                             live_stall_s=5.0, sfe_bands=2,
+                             heartbeat_throttle_s=0.0)
+        reg = WorkerRegistry()
+        for i in range(8):
+            reg.heartbeat(f"w{i}")
+        coord = Coordinator(registry=reg, settings_fn=lambda: snap)
+        execu = LocalExecutor(coord, output_dir=str(tmp_path / "lib"),
+                              sync=True)
+        coord._launcher = execu.launch
+        job = coord.add_job(path, meta)
+        job = coord.store.get(job.id)
+        assert job.status is Status.DONE, job.failure_reason
+        tree = tmp_path / "lib" / "clip.live.hls"
+        assert (tree / "master.m3u8").exists()
+        # SFE frames flowed through the per-frame pipeline
+        from thinvids_tpu.parallel.dispatch import stage_snapshot
+
+        assert stage_snapshot().get("sfe_frames", 0) >= 12
+
+
+# ---------------------------------------------------------------------------
+# hermetic cross-host end-to-end (subprocess farm over HTTP)
+# ---------------------------------------------------------------------------
+
+
+def _call(base, path, method="GET", body=None, timeout=10):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(base + path, data=data, method=method)
+    if data:
+        req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _wait(predicate, deadline_s, interval=0.25, what="condition"):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        out = predicate()
+        if out:
+            return out
+        time.sleep(interval)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def _try_health(base):
+    try:
+        return _call(base, "/health", timeout=3)
+    except (urllib.error.URLError, ConnectionError, OSError):
+        return None
+
+
+def _job_if_terminal(base, job_id):
+    job = _call(base, f"/job_properties/{job_id}")["job"]
+    return job if job["status"] in ("done", "failed", "stopped") \
+        else None
+
+
+def test_farm_sfe_end_to_end_two_workers(tmp_path):
+    """Acceptance: coordinator + 2 single-device worker daemons encode
+    ONE stream as frame-band shards — halo rows crossing hosts per
+    frame over /work/halo — and the stitched MP4 is BYTE-identical to
+    a local-mesh SFE encode with the same 2-band layout. The job's
+    distributed trace carries both workers' band spans under one trace
+    id."""
+    import socket as socket_mod
+
+    clip = tmp_path / "clip.y4m"
+    meta = write_clip(clip, w=64, h=96, n=12)
+    ref_settings = make_settings(gop_frames=3, qp=30, sfe_bands=2,
+                                 heartbeat_throttle_s=0.0)
+    reg = WorkerRegistry()
+    for i in range(8):
+        reg.heartbeat(f"ref{i}")
+    ref_coord = Coordinator(registry=reg,
+                            settings_fn=lambda: ref_settings)
+    ref_exec = LocalExecutor(ref_coord,
+                             output_dir=str(tmp_path / "lib_local"),
+                             sync=True)
+    ref_coord._launcher = ref_exec.launch
+    ref_job = ref_coord.add_job(str(clip), meta)
+    ref_job = ref_coord.store.get(ref_job.id)
+    assert ref_job.status is Status.DONE, ref_job.failure_reason
+    with open(ref_job.output_path, "rb") as fp:
+        want = fp.read()
+
+    with socket_mod.socket() as sk:
+        sk.bind(("127.0.0.1", 0))
+        port = sk.getsockname()[1]
+    base = f"http://127.0.0.1:{port}"
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+        TVT_EXECUTION_BACKEND="remote", TVT_SFE_BANDS="2",
+        TVT_MIN_IDLE_WORKERS="0", TVT_PIPELINE_WORKER_COUNT="3",
+        TVT_METRICS_TTL_S="3", TVT_REMOTE_RETRY_BACKOFF_S="0.2",
+        TVT_GOP_FRAMES="3", TVT_QP="30", TVT_SCHEDULER_POLL_S="0.5",
+        TVT_HALO_TIMEOUT_S="120")
+    coord = subprocess.Popen(
+        [sys.executable, "-m", "thinvids_tpu.cli", "coordinator",
+         "--host", "127.0.0.1", "--port", str(port),
+         "--state-dir", str(tmp_path / "state"),
+         "--output-dir", str(tmp_path / "library")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    workers = []
+    try:
+        _wait(lambda: _try_health(base), 45, what="coordinator API")
+        for i in range(2):
+            workers.append(subprocess.Popen(
+                [sys.executable, "-m", "thinvids_tpu.cli", "worker",
+                 "--coordinator", base, "--node-name", f"farmsfe-w{i}",
+                 "--interval", "0.3", "--poll", "0.2"],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT))
+        _wait(lambda: len([n for n in _call(base, "/nodes_data")["nodes"]
+                           if n["host"].startswith("farmsfe-w")]) == 2,
+              30, what="both workers registered")
+        job = _call(base, "/add_job", "POST",
+                    {"input_path": str(clip)})
+        done = _wait(lambda: _job_if_terminal(base, job["id"]), 300,
+                     what="farm SFE job terminal")
+        assert done["status"] == "done", done
+        with open(done["output_path"], "rb") as fp:
+            got = fp.read()
+        assert got == want, (
+            f"farm SFE output diverged from the local-mesh SFE "
+            f"reference ({len(got)} vs {len(want)} bytes)")
+        # one trace id spans both hosts' band work (PR 10 acceptance)
+        trace = json.dumps(_call(base, f"/trace/{job['id']}"))
+        assert "farmsfe-w0" in trace and "farmsfe-w1" in trace
+        assert "worker_shard" in trace
+    finally:
+        for p in workers:
+            p.kill()
+        coord.kill()
+        for p in workers + [coord]:
+            p.wait(10)

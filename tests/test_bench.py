@@ -65,6 +65,11 @@ def test_bench_result_schema_includes_stage_ms():
     sfe = {"fps": 5.6, "latency_ms_p50": 178.0, "latency_ms_p99": 201.0,
            "bands": 8, "halo_rows": 32, "bytes": 3_000_000,
            "stage_ms": {}}
+    sfe_farm = {"workers": {1: 1.4, 2: 2.5, 4: 4.1},
+                "bands": {1: 1, 2: 2, 4: 4}, "halo_rows": 32}
+    live_sfe = {"latency_s": 0.31, "latency_p99_s": 0.44,
+                "dvr_segments": 2, "segment_s": 1.0,
+                "ingest_fps": 11.0, "gops": 6}
     trace = {"fps_off": 33.5, "fps_on": 33.1, "overhead_pct": 1.2,
              "sampled": True}
     autoscale = {"p99_queue_s": 4.2, "active_worker_s": 41.0,
@@ -77,6 +82,7 @@ def test_bench_result_schema_includes_stage_ms():
     result = bench.build_result(r, r4k, platform="cpu", qp=27, gop=8,
                                 n_1080=64, cold=cold, ladder=ladder,
                                 live=live, origin=origin, sfe=sfe,
+                                sfe_farm=sfe_farm, live_sfe=live_sfe,
                                 trace=trace, autoscale=autoscale,
                                 crash=crash)
     assert result["value"] == 33.3
@@ -129,6 +135,15 @@ def test_bench_result_schema_includes_stage_ms():
     assert result["origin_p50_segment_ms"] == 2.1
     assert result["origin_requests"] == 120000
     assert result["live_latency_under_load_s"] == 0.9
+    # farm SFE: the single-stream worker-count scaling curve is a
+    # pinned key per worker count (the 2w >= 1.5 x 1w acceptance bar
+    # reads these)
+    assert result["sfe_fps_2160p_w1"] == 1.4
+    assert result["sfe_fps_2160p_w2"] == 2.5
+    assert result["sfe_fps_2160p_w4"] == 4.1
+    # live with a banded (SFE) edge: glass-to-playlist latency line
+    assert result["live_sfe_latency_s"] == 0.31
+    assert result["live_sfe_latency_p99_s"] == 0.44
     # distributed-tracing cost on the e2e hot path is a pinned BENCH
     # key (acceptance gate: < 3% on the driver's run)
     assert result["trace_overhead_pct"] == 1.2
@@ -205,6 +220,32 @@ def test_run_origin_serves_mixed_load():
     assert r["live_latency_under_load_s"] > 0
     assert r["requests"] > 0 and r["errors"] <= 2
     assert r["origin_hits"] > 0        # hot segments came from memory
+
+
+@pytest.mark.slow
+def test_run_sfe_farm_scaling_smoke():
+    """The farm-SFE bench drives the PRODUCTION cross-host path: an
+    in-process coordinator planning band shards + real single-device
+    worker subprocesses exchanging halo per frame over /work/halo.
+    Small here (1 and 2 workers, tiny frames — the harness is the
+    measured quantity); the driver's run uses 2160p at 1/2/4."""
+    r = bench._run_sfe_farm(64, 96, nframes=6, qp=27, gop_frames=3,
+                            worker_counts=(1, 2))
+    assert set(r["workers"]) == {1, 2}
+    assert all(fps > 0 for fps in r["workers"].values())
+    assert r["halo_rows"] >= 16
+
+
+@pytest.mark.slow
+def test_run_live_sfe_reports_latency_smoke():
+    """_run_live with sfe_bands runs the banded live edge (single-rung
+    stream through the per-frame SFE pipeline) and reports the same
+    glass-to-playlist schema."""
+    r = bench._run_live(64, 48, nframes=12, qp=27, gop_frames=3,
+                        rungs_spec="48", segment_s=0.25,
+                        dvr_window_s=0.0, sfe_bands=2)
+    assert r["latency_s"] > 0
+    assert r["latency_p99_s"] >= r["latency_s"]
 
 
 @pytest.mark.slow

@@ -75,6 +75,13 @@ SHARD_MACHINE = StateMachine(
         ("PENDING", "DONE"),        # late part after requeue (open wins)
         ("ASSIGNED", "PENDING"),    # failure/expiry requeue, preemption
         ("ASSIGNED", "FAILED"),     # attempt budget exhausted
+        # band-group restart (farm SFE, ISSUE 14): band shards encode
+        # in LOCKSTEP, so when one falls back to PENDING its DONE
+        # siblings requeue too — their spooled parts are RETRACTED
+        # first (drop_done), so first-result-wins and resume-reuse
+        # stay intact (the re-encode deterministically re-submits the
+        # same bytes)
+        ("DONE", "PENDING"),
     ),
     predicates={"is_open": ("PENDING", "ASSIGNED")},
 )
@@ -206,6 +213,10 @@ class Manifest:
         # control-plane threads (API handlers, the drain loop) — never
         # on a mesh
         "thinvids_tpu.cluster.partstore",
+        # the cross-host halo relay/transport (farm SFE) runs on
+        # coordinator API threads and worker control flow; the device
+        # math it feeds lives in parallel/sfefarm
+        "thinvids_tpu.cluster.halo",
         # the observability layer (metrics registry, trace store,
         # flight recorder) runs on coordinator/worker control-plane
         # threads and inside jax-free sidecars
@@ -247,6 +258,11 @@ class Manifest:
     #: wave hot path. (Formerly tests/test_compact.py's ALLOWED set.)
     sync_allowlist: tuple[str, ...] = (
         "thinvids_tpu.parallel.dispatch",
+        # the farm-SFE band executor owns the same device→host
+        # boundary as dispatch: per-frame tiny-count barriers, halo
+        # edge-row fetches, and the probe/histogram partial reads that
+        # MUST leave the device between lockstep exchanges
+        "thinvids_tpu.parallel.sfefarm",
         "thinvids_tpu.codecs.h264.jaxcore",
         "thinvids_tpu.codecs.h264.encoder",
         "thinvids_tpu.tools",
@@ -302,6 +318,12 @@ class Manifest:
             "thinvids_tpu.cluster.remote:ShardBoard._jobs": "_lock",
             "thinvids_tpu.cluster.remote:ShardBoard._order": "_lock",
             "thinvids_tpu.cluster.remote:ShardBoard._parts": "_lock",
+            # claim-affinity scoring map: read+written inside claim's
+            # locked section only
+            "thinvids_tpu.cluster.remote:ShardBoard._affinity": "_lock",
+            # halo relay rendezvous store: API handler threads post,
+            # long-polls park on the same condition's lock
+            "thinvids_tpu.cluster.halo:HaloRelay._jobs": "_cond",
             "thinvids_tpu.cluster.jobs:JobStore._jobs": "_lock",
             "thinvids_tpu.cluster.partstore:PartStore._journals": "_lock",
             "thinvids_tpu.cluster.partstore:PartStore._spool_bytes":

@@ -387,6 +387,10 @@ MUTATIONS = (
                              # counts as a shard failure
     "ingest_no_verify",      # /work ingest accepts a digest-mismatched
                              # part as DONE
+    "band_restart_keeps_spool",  # a band-group restart requeues a
+                             # DONE shard WITHOUT retracting its
+                             # spooled part (the next claim re-leases
+                             # work the spool already holds)
     "stitch_no_verify",      # collect stitches a spooled part whose
                              # digest no longer verifies
 )
@@ -508,6 +512,13 @@ class BoardModel:
                 out.append(("restart",))
             elif act == "cancel" and entry is not None:
                 out.append(("cancel",))
+            elif act == "band_restart" and entry is not None and any(
+                    sh[0] == PENDING for sh in shards) and any(
+                    sh[0] in (ASSIGNED, DONE) for sh in shards):
+                # a band shard fell back to PENDING while its lockstep
+                # siblings hold leases / finished parts: the group
+                # restarts together (ShardBoard._restart_band_group)
+                out.append(("band_restart",))
             elif act in ("cancel_stale", "collect_stale") and run == 2 \
                     and entry is not None:
                 out.append((act,))
@@ -699,6 +710,24 @@ class BoardModel:
                     spool(i, CK_NONE)    # retracted + unlinked
                     if "resume_burns_attempt" in self.mut:
                         upd(i, attempt=1)
+        elif kind == "band_restart":
+            # lockstep band-group restart: ASSIGNED siblings requeue
+            # free (preemption semantics — the evicted worker's late
+            # part is still a late part), DONE siblings requeue with
+            # their spooled part RETRACTED (drop_done) so neither
+            # first-result-wins nor resume-reuse is violated — the
+            # re-encode deterministically re-submits identical bytes.
+            # `band_restart_keeps_spool` skips the retraction: the
+            # seeded break the resume-reuse invariant catches at the
+            # next claim.
+            for i, sh in enumerate(shards):
+                if sh[0] == ASSIGNED:
+                    upd(i, state=PENDING, host="", not_before=t)
+                elif sh[0] == DONE:
+                    if "band_restart_keeps_spool" not in self.mut:
+                        spool(i, CK_NONE)
+                    upd(i, state=PENDING, host="", finisher="",
+                        not_before=t)
         elif kind in ("cancel", "cancel_stale"):
             if kind == "cancel" or "no_token_fence" in self.mut:
                 entry = None
@@ -837,9 +866,13 @@ def _check_transition(pre, action, post, edges, notes,
                 f"the output tree")
     # done-absorbs BEFORE the generic edge check: overwriting a DONE
     # shard must be named as the first-result-wins break it is, not as
-    # a generic undeclared DONE→DONE edge
+    # a generic undeclared DONE→DONE edge. band_restart is exempt BY
+    # DESIGN: it retracts the spooled part as it requeues (DONE is
+    # un-finished, not overwritten — the declared DONE→PENDING edge),
+    # and the resume-reuse claim check still catches a restart that
+    # forgets the retraction.
     if kind not in ("restart", "crash", "cancel", "collect",
-                    "cancel_stale", "collect_stale"):
+                    "cancel_stale", "collect_stale", "band_restart"):
         pre_shards, post_shards = pre[3], post[3]
         for i, sh in enumerate(pre_shards):
             if sh[0] == DONE and (post_shards[i][0] != DONE
@@ -930,6 +963,14 @@ SCENARIOS: tuple[Scenario, ...] = (
     Scenario("drain", ("claim", "submit", "tick", "sweep", "drain",
                        "undrain", "suspend", "wake", "wake_fail",
                        "rejoin", "hb"), depth=8,
+             cfg=ModelConfig(shards=2, t_max=3)),
+    # band-group lockstep restart (farm SFE): one band shard's
+    # requeue drags its ASSIGNED/DONE siblings back to PENDING with
+    # parts retracted — proves the DONE→PENDING edge burns no
+    # attempts, never strands first-result-wins, and never re-leases
+    # a shard whose verified part is still spooled
+    Scenario("band", ("claim", "submit", "fail", "band_restart",
+                      "tick", "sweep", "collect"), depth=8,
              cfg=ModelConfig(shards=2, t_max=3)),
     # durable checkpointing: coordinator SIGKILL + resume driven
     # against spool corruption and corrupt in-flight uploads. Proves a

@@ -118,8 +118,10 @@ class _TextResponse:
     """Handler payload sentinel: serve a plain-text body (the
     Prometheus exposition at GET /metrics)."""
 
-    def __init__(self, text: str, content_type: str = "text/plain") -> None:
-        self.body = text.encode("utf-8")
+    def __init__(self, text: "str | bytes",
+                 content_type: str = "text/plain") -> None:
+        self.body = text if isinstance(text, bytes) \
+            else text.encode("utf-8")
         self.content_type = content_type
 
 
@@ -172,6 +174,12 @@ class ApiServer:
             # connections are reaped by the socket timeout.
             protocol_version = "HTTP/1.1"
             timeout = 60
+            # TCP_NODELAY: the farm-SFE halo relay exchanges several
+            # SMALL request/response pairs per encoded frame, and
+            # Nagle+delayed-ACK stalls (~40 ms each) would dominate
+            # the per-frame budget; origin segment replies are bulk
+            # writes where Nagle buys nothing anyway
+            disable_nagle_algorithm = True
 
             # quiet request logging (the reference silenced werkzeug,
             # /root/reference/common.py:151-161)
@@ -407,6 +415,8 @@ class ApiServer:
         ("POST", r"^/work/part/(?P<shard_id>[\w:-]+)$", "work_part"),
         ("POST", r"^/work/spans$", "work_spans"),
         ("POST", r"^/work/status$", "work_status"),
+        ("POST", r"^/work/halo$", "work_halo_post"),
+        ("GET", r"^/work/halo$", "work_halo_get"),
         ("POST", r"^/work/chaos$", "work_chaos"),
         ("GET", r"^/work/board$", "work_board"),
         ("GET", r"^/settings$", "get_settings"),
@@ -987,6 +997,10 @@ class ApiServer:
             for state in ShardState:
                 shard_states.labels(state.value).set(
                     counts.get(state.value, 0))
+            halo = (self.work.halo.snapshot()
+                    if self.work is not None else {})
+            obs_metrics.HALO_RELAY_BLOBS.set(halo.get("blobs", 0))
+            obs_metrics.HALO_RELAY_BYTES.set(halo.get("bytes", 0))
             return 200, _TextResponse(
                 obs_metrics.REGISTRY.render(),
                 "text/plain; version=0.0.4; charset=utf-8")
@@ -1112,9 +1126,53 @@ class ApiServer:
         shard_id = str(body.get("shard_id", "")).strip()
         if not shard_id:
             raise ApiError(400, "shard_id required")
-        board.report_failure(shard_id, str(body.get("host", "")),
-                             str(body.get("error", "worker error")))
+        if body.get("unsupported"):
+            # shape rejection (old worker): requeue with NO attempt
+            # burned and stop offering the shard to this host
+            board.report_unsupported(
+                shard_id, str(body.get("host", "")),
+                str(body.get("error", "unsupported shard shape")))
+        else:
+            board.report_failure(shard_id, str(body.get("host", "")),
+                                 str(body.get("error", "worker error")))
         return 200, {"ok": True}
+
+    def _h_work_halo_post(self, query, body) -> tuple[int, Any]:
+        """Band-shard halo relay ingest (cluster/halo.py): a worker
+        posts one digest-framed blob (neighbor recon rows, probe or
+        histogram partial) keyed by (seq, band, kind); `stale` tells a
+        superseded-generation worker to abandon its shard."""
+        board = self._work_board_or_503()
+        raw = body.get("_raw")
+        if not isinstance(raw, (bytes, bytearray)):
+            raise ApiError(400, "binary halo body required "
+                                "(Content-Type: application/octet-stream)")
+        ok = board.halo.post(
+            str(query["job"]), int(query.get("gen", 1)),
+            int(query["seq"]), int(query["band"]),
+            str(query["kind"]), bytes(raw))
+        return 200, ({"ok": True} if ok else {"stale": True})
+
+    def _h_work_halo_get(self, query, body) -> tuple[int, Any]:
+        """Band-shard halo relay fetch: long-polls up to `wait`
+        seconds server-side (bounded — the client re-polls against its
+        own halo_timeout_s budget), answering the blob as binary,
+        `pending` when it has not arrived, or `stale` when the band
+        group restarted under a newer generation."""
+        from ..cluster.halo import HaloStaleError
+
+        board = self._work_board_or_503()
+        wait = min(10.0, max(0.0, float(query.get("wait", 2.0))))
+        try:
+            blob = board.halo.wait(
+                str(query["job"]), int(query.get("gen", 1)),
+                int(query["seq"]), int(query["band"]),
+                str(query["kind"]), wait)
+        except HaloStaleError:
+            return 200, {"stale": True}
+        if blob is None:
+            return 200, {"pending": True}
+        return 200, _TextResponse(blob, "application/octet-stream")
 
     def _h_work_chaos(self, query, body) -> tuple[int, Any]:
         """Chaos-injection control channel for the out-of-process

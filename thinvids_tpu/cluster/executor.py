@@ -101,28 +101,16 @@ class LocalExecutor:
 
     @staticmethod
     def _default_encoder(meta, settings, mesh):
-        """GOP-wave encoder by default; `sfe_bands > 0` selects the
-        split-frame mode (one frame sharded across the mesh as MB-row
-        band slices — the single-stream latency path; 0 keeps current
-        behavior byte-identical). SFE runs on the LOCAL mesh only: the
-        remote backend farms GOP shards across hosts, and a per-frame
-        halo exchange belongs on a mesh interconnect, not HTTP."""
-        sfe_bands = int(settings.get("sfe_bands", 0) or 0)
-        if sfe_bands > 0:
-            from ..parallel.dispatch import SfeShardEncoder
+        """Plan-driven encoder resolution (parallel/dispatch.
+        make_shard_encoder): `sfe_bands > 0` selects the split-frame
+        band shape (one frame sharded across the mesh as MB-row band
+        slices — the single-stream latency path; 0 keeps current
+        behavior byte-identical), else GOP waves. The remote backend
+        resolves through the SAME seam — its band shape additionally
+        spans hosts (cluster/remote.py band shards + halo relay)."""
+        from ..parallel.dispatch import make_shard_encoder
 
-            return SfeShardEncoder(
-                meta, qp=int(settings.qp), mesh=mesh,
-                gop_frames=int(settings.gop_frames),
-                max_segments=int(settings.max_segments),
-                bands=sfe_bands,
-                halo_rows=int(settings.get("sfe_halo_rows", 32)))
-        from ..parallel.dispatch import GopShardEncoder
-
-        return GopShardEncoder(
-            meta, qp=int(settings.qp), mesh=mesh,
-            gop_frames=int(settings.gop_frames),
-            max_segments=int(settings.max_segments))
+        return make_shard_encoder(meta, settings, mesh)
 
     def run(self, job: Job) -> None:
         token = job.run_token
@@ -237,8 +225,8 @@ class LocalExecutor:
         rungs scale on device). Returns (rungs, {rung name → ordered
         EncodedSegments}). The seam the remote backend overrides to
         farm rung×shard work instead (cluster/remote.py)."""
-        from ..abr.ladder import (LadderShardEncoder, plan_ladder,
-                                  rung_segments)
+        from ..abr.ladder import plan_ladder, rung_segments
+        from ..parallel.dispatch import make_shard_encoder
 
         co = self.coordinator
         if str(settings.rc_mode) == "vbr2pass":
@@ -250,10 +238,7 @@ class LocalExecutor:
                 job_id=job.id, host=self.host)
         stage[0] = "segment"
         rungs = plan_ladder(meta, settings)
-        enc = LadderShardEncoder(
-            meta, rungs, mesh=self.mesh,
-            gop_frames=int(settings.gop_frames),
-            max_segments=int(settings.max_segments))
+        enc = make_shard_encoder(meta, settings, self.mesh, rungs=rungs)
         self._bind_trace(job, enc)
         plan = enc.plan(len(frames))
         co.update_progress(job.id, token, parts_total=plan.num_gops,
@@ -337,7 +322,7 @@ class LocalExecutor:
         import shutil
 
         from ..abr import hls
-        from ..abr.ladder import LadderShardEncoder, plan_ladder
+        from ..abr.ladder import plan_ladder
         from ..ingest.tail import TailFrameSource
         from ..live.packager import LiveLadderPackager
 
@@ -350,9 +335,7 @@ class LocalExecutor:
             raise HaltedError("fenced before start")
         gop_n = int(settings.gop_frames)
         rungs = plan_ladder(meta, settings)
-        enc = LadderShardEncoder(
-            meta, rungs, mesh=self.mesh, gop_frames=gop_n,
-            max_segments=int(settings.max_segments))
+        enc, sfe_live = self._live_encoder(meta, settings, rungs)
         self._bind_trace(job, enc)
         base = os.path.splitext(os.path.basename(job.input_path))[0]
         out_dir = os.path.join(self.output_dir, base + ".hls")
@@ -383,7 +366,7 @@ class LocalExecutor:
         # (cluster/qos.py). 0 = auto: 2x the stream's segment duration.
         part_budget = float(settings.get("live_part_budget_s", 0.0)) \
             or 2.0 * float(settings.get("segment_s", 6.0))
-        wave_cap = enc.num_devices * enc.gops_per_wave
+        wave_cap = self._live_backlog_cap(job, settings, enc)
         frames_done = gops_done = 0
         published = False
         while True:
@@ -402,24 +385,13 @@ class LocalExecutor:
             else:
                 whole = (avail - frames_done) // gop_n
                 # at the live edge whole==1 (lowest latency); during
-                # catch-up batch up to one wave per dispatch
+                # catch-up batch up to the backlog cap per dispatch
+                # (one local wave — or the whole farm's width when the
+                # remote backend fans catch-up GOPs out)
                 count = min(whole, wave_cap) * gop_n
-            # GOP indices / frame ranges continue the global stream
-            # (same offset contract the elastic replan uses), and the
-            # batch's GOP boundaries are pinned EXPLICITLY: the local
-            # planner balances GOP lengths to the mesh width, which
-            # would make part boundaries depend on arrival timing and
-            # device count — a live stream's GOP grid must be a pure
-            # function of the frame index (gop_frames-sized, like the
-            # remote backend's shard plan_override contract)
-            enc.gop_index_offset = gops_done
-            enc.frame_offset = frames_done
-            enc.plan_override = _live_batch_plan(count, gop_n,
-                                                 enc.num_devices)
-            # lazy window, not a materialized list: the staging thread
-            # decodes the batch wave-by-wave (bounded residency, same
-            # contract as batch ingest)
-            bundles = enc.encode(tail[frames_done:frames_done + count])
+            bundles = self._live_encode_batch(
+                job, token, settings, enc, rungs, tail, frames_done,
+                gops_done, count, gop_n, sfe_live)
             for bundle in bundles:
                 packager.add_gop(bundle)
             if not published:
@@ -465,6 +437,60 @@ class LocalExecutor:
                            combine_progress=100.0)
         co.complete_job(job.id, token, packager.master_path,
                         packager.total_bytes())
+
+    def _live_encoder(self, meta, settings, rungs):
+        """Live-edge encoder selection (plan-driven, like every other
+        path): the ladder stack by default; a SINGLE-rung stream with
+        `sfe_bands > 0` runs the split-frame encoder at the live edge
+        instead — every frame sharded across the mesh as band slices,
+        so glass-to-playlist latency rides the per-frame SFE pipeline
+        rather than whole-GOP waves. Returns (encoder, sfe_mode)."""
+        from ..parallel.dispatch import make_shard_encoder
+
+        sfe_bands = int(settings.get("sfe_bands", 0) or 0)
+        if sfe_bands > 0 and len(rungs) == 1:
+            return make_shard_encoder(meta, settings, self.mesh,
+                                      shape="band"), True
+        return make_shard_encoder(meta, settings, self.mesh,
+                                  rungs=rungs), False
+
+    def _live_backlog_cap(self, job, settings, enc) -> int:
+        """Whole GOPs one catch-up dispatch may batch: one local wave.
+        The remote backend widens this to the farm (its override fans
+        the backlog across workers) — but only when the fan-out will
+        actually engage, so a disabled knob keeps the pre-farm local
+        batch bound."""
+        return enc.num_devices * enc.gops_per_wave
+
+    def _live_encode_batch(self, job, token, settings, enc, rungs,
+                           tail, frames_done: int, gops_done: int,
+                           count: int, gop_n: int, sfe_live: bool):
+        """Encode one live batch (the seam the remote backend overrides
+        to fan catch-up GOPs across the farm). GOP indices / frame
+        ranges continue the global stream (same offset contract the
+        elastic replan uses), and the batch's GOP boundaries are
+        pinned EXPLICITLY: the local planner balances GOP lengths to
+        the mesh width, which would make part boundaries depend on
+        arrival timing and device count — a live stream's GOP grid
+        must be a pure function of the frame index (gop_frames-sized,
+        like the remote backend's shard plan_override contract)."""
+        enc.gop_index_offset = gops_done
+        enc.frame_offset = frames_done
+        enc.plan_override = _live_batch_plan(count, gop_n,
+                                             enc.num_devices)
+        # lazy window, not a materialized list: the staging thread
+        # decodes the batch wave-by-wave (bounded residency, same
+        # contract as batch ingest)
+        out = enc.encode(tail[frames_done:frames_done + count])
+        if not sfe_live:
+            return out
+        # SFE live edge: plain EncodedSegments wrap into single-rung
+        # bundles so the incremental packager consumes them unchanged
+        from ..abr.ladder import LadderGopBundle
+
+        return [LadderGopBundle(gop=s.gop,
+                                renditions={rungs[0].name: s})
+                for s in out]
 
     @staticmethod
     def _warm_live_shapes(enc, meta, gop_n: int) -> None:

@@ -151,8 +151,13 @@ def unpack_parts(data: bytes, verify: bool = True) -> list[EncodedSegment]:
 
 @dataclasses.dataclass
 class Shard:
-    """A contiguous GOP range of one job, leased to one worker at a
-    time (the analog of a reference 'part' task on the encode queue)."""
+    """One leased unit of a job's encode — either a contiguous GOP
+    *range* (the classic farm shape: whole GOPs on one worker) or a
+    frame *band* (farm SFE: a contiguous slice of the job's global
+    band layout, every GOP, with per-frame halo exchange against the
+    sibling band shards — parallel/sfefarm.py). One worker holds the
+    lease at a time (the analog of a reference 'part' task on the
+    encode queue)."""
 
     id: str
     job_id: str
@@ -162,6 +167,23 @@ class Shard:
     qp: int
     gop_frames: int
     timeout_s: float
+    #: shard shape: "gop" (GOP range — today's wire form, absent on
+    #: the wire for rolling-upgrade compat) or "band" (frame-band
+    #: slice). Workers that don't recognize a shape reject it as
+    #: UNSUPPORTED: the board requeues with NO attempt burned and
+    #: stops offering the shard to that host.
+    shape: str = "gop"
+    #: band shape only: this shard's [band_start, band_start +
+    #: band_count) slice of the job's `total_bands`-band layout, plus
+    #: the pinned halo depth every sibling agrees on
+    band_start: int = 0
+    band_count: int = 0
+    total_bands: int = 0
+    halo_rows: int = 0
+    #: hosts that rejected this shard's shape (old workers): the claim
+    #: never offers it to them again, so an unsupported rejection
+    #: cannot ping-pong
+    no_hosts: tuple[str, ...] = ()
     # ABR ladder (abr/ladder.py): which rendition this shard encodes;
     # empty = plain single-rendition shard. Scaled rungs carry their
     # target dims — the worker derives them on ITS device mesh from the
@@ -247,6 +269,20 @@ class Shard:
         if self.rung:
             desc["rung"] = {"name": self.rung, "width": self.rung_width,
                             "height": self.rung_height}
+        if self.shape != "gop":
+            # explicit shape tag ONLY for new shapes: a GOP-range
+            # shard's wire form is unchanged, so a rolling upgrade
+            # keeps old workers serving GOP shards while band shards
+            # flow to new ones (unknown shape → unsupported-requeue)
+            desc["shape"] = self.shape
+        if self.shape == "band":
+            desc["band"] = {
+                "start": self.band_start, "count": self.band_count,
+                "total": self.total_bands, "halo_rows": self.halo_rows,
+                # groups + halo generation are board state (the full
+                # sibling partition and the current exchange epoch):
+                # ShardBoard.claim fills them in at grant time
+            }
         if self.trace_id:
             desc["trace"] = {"trace_id": self.trace_id,
                              "job_id": self.job_id}
@@ -265,6 +301,11 @@ class _JobEntry:
     failed_reason: str = ""
     failed_host: str = ""
     retried_parts: int = 0
+    #: halo-exchange generation for band shards (cluster/halo.py):
+    #: bumped whenever a band shard leaves its lease abnormally — the
+    #: sibling group restarts together (the exchange is lockstep) and
+    #: stale workers' halo traffic answers `stale`
+    halo_gen: int = 1
 
 
 class ShardBoard:
@@ -298,6 +339,18 @@ class ShardBoard:
         #: spool into a private temp dir.
         self._spool_dir = spool_dir
         self._parts: PartStore | None = None
+        #: cross-host halo rendezvous for band shards (cluster/halo.py;
+        #: served at /work/halo). Generation-fenced by the entries'
+        #: halo_gen.
+        from .halo import HaloRelay
+
+        self.halo = HaloRelay()
+        #: claim affinity: host → {input_path: last claimed END frame}
+        #: — the claim prefers shards whose source range continues what
+        #: the worker's source cache already covers (a neighboring
+        #: range re-claims decode the prefix otherwise). Bounded per
+        #: host; purely a scoring hint, no protocol change.
+        self._affinity: dict[str, dict[str, int]] = {}
 
     @property
     def parts(self) -> PartStore:
@@ -326,11 +379,24 @@ class ShardBoard:
                 # supersedes it outright
                 self._order = [sid for sid in self._order
                                if sid not in stale.shards]
-            self._jobs[job_id] = _JobEntry(
+            entry = _JobEntry(
                 shards={s.id: s for s in shards},
                 max_attempts=max_attempts, backoff_s=backoff_s,
-                quarantine_after=quarantine_after, owner_token=token)
+                quarantine_after=quarantine_after, owner_token=token,
+                # the halo generation CONTINUES across a superseding
+                # re-add: the stale entry's in-flight workers carry its
+                # gen and must see `stale`, not adopt the new group
+                halo_gen=(stale.halo_gen + 1 if stale is not None
+                          else 1))
+            self._jobs[job_id] = entry
             self._order.extend(s.id for s in shards)
+            banded = any(s.shape == "band" for s in shards)
+            gen = entry.halo_gen
+        if banded:
+            # seed the halo relay: only SEEDED jobs may rendezvous
+            # (posts/waits against an unknown job answer `stale`
+            # instead of resurrecting a cleared entry — halo.py)
+            self.halo.set_gen(job_id, gen)
 
     def rehydrate_done(self, shard: Shard, ref: PartRef) -> None:
         """Crash-resume: mark one freshly planned shard DONE from a
@@ -381,6 +447,7 @@ class ShardBoard:
             del self._jobs[job_id]
             self._order = [sid for sid in self._order
                            if sid not in entry.shards]
+        self.halo.clear_job(job_id)
 
     def job_progress(self, job_id: str) -> tuple[int, int, int, str, str]:
         """(gops_done, gops_total, parts_retried, failed_reason,
@@ -425,6 +492,7 @@ class ShardBoard:
                         f"collected shard {shard.id} in state "
                         f"{shard.state.value}")
             shards = list(entry.shards.values())
+        self.halo.clear_job(job_id)
         verify = bool(self.coordinator._settings_fn().get(
             "part_integrity", True))
         parts = self.parts
@@ -540,8 +608,15 @@ class ShardBoard:
                 for s in entry.shards.values():
                     if s.state is ShardState.ASSIGNED:
                         usage[s.tenant] = usage.get(s.tenant, 0.0) + 1.0
+            seen = self._affinity.get(host, {})
+            host_devices = 1
+            for wk in self.coordinator.registry.all():
+                if wk.host == host:
+                    host_devices = max(1, int((wk.metrics or {}).get(
+                        "worker_devices", 1) or 1))
+                    break
             best: Shard | None = None
-            best_key: tuple[int, float, int] | None = None
+            best_key: tuple[int, float, int, int] | None = None
             for pos, sid in enumerate(self._order):
                 shard = self._find_locked(sid)
                 if (shard is None or shard.state is not ShardState.PENDING
@@ -549,8 +624,30 @@ class ShardBoard:
                     continue
                 if batch_gated and shard.priority >= BATCH_RANK:
                     continue
+                if host in shard.no_hosts:
+                    continue        # this host rejected the shape
+                if shard.shape == "band" \
+                        and shard.band_count > host_devices:
+                    # a band slice never fits a smaller mesh than it
+                    # was planned for: granting would fail the encode,
+                    # burn an attempt AND restart the lockstep group —
+                    # an under-provisioned late joiner must simply
+                    # never see the shard
+                    continue
+                # affinity score (0 best): the worker's source cache
+                # already covers this input and the shard CONTINUES
+                # its last range (the cached demux state decodes
+                # forward, no prefix re-walk) > same input (open
+                # source reused) > cold open. Strictly below priority
+                # and tenant fairness — a hint, never a policy.
+                if shard.input_path in seen:
+                    affinity = 0 if seen[shard.input_path] \
+                        == shard.start_frame else 1
+                else:
+                    affinity = 2
                 key = (shard.priority,
-                       fair_usage(shares, usage, shard.tenant), pos)
+                       fair_usage(shares, usage, shard.tenant),
+                       affinity, pos)
                 if best_key is None or key < best_key:
                     best, best_key = shard, key
             if best is not None and best.state is ShardState.PENDING:
@@ -563,6 +660,19 @@ class ShardBoard:
                 best.assigned_at = now
                 best.deadline_at = now + best.timeout_s
                 granted = best.descriptor()
+                if best.shape == "band":
+                    entry = self._jobs[best.job_id]
+                    granted["band"]["gen"] = entry.halo_gen
+                    granted["band"]["groups"] = sorted(
+                        [s.band_start, s.band_start + s.band_count]
+                        for s in entry.shards.values()
+                        if s.shape == "band")
+                # affinity record: remember where this host's source
+                # cursor for the input will END (bounded per host)
+                rec = self._affinity.setdefault(host, {})
+                rec[best.input_path] = best.gops[-1].end_frame
+                while len(rec) > 4:
+                    rec.pop(next(iter(rec)))
                 # grant-heartbeat INSIDE the lock: the lease and the
                 # liveness refresh commit atomically w.r.t. the sweep
                 # (which reads the registry under this same lock), so
@@ -664,6 +774,7 @@ class ShardBoard:
         with attribution instead of livelocking."""
         requeued = False
         escalate = False
+        band_job = ""
         with self._lock:
             self._integrity_rejects += 1
             shard = self._find_locked(shard_id)
@@ -678,6 +789,8 @@ class ShardBoard:
                     shard.assigned_host = ""
                     shard.not_before = 0.0
                     requeued = True
+                    if shard.shape == "band":
+                        band_job = shard.job_id
         obs_metrics.PART_INTEGRITY_FAILURES.inc()
         self.coordinator.activity.emit(
             "integrity",
@@ -686,16 +799,128 @@ class ShardBoard:
             + (" (lease requeued, no attempt burned)" if requeued
                else "") + f": {reason}",
             host=host)
+        if band_job:
+            self._restart_band_group(band_job)
         if escalate:
             self.report_failure(
                 shard_id, host,
                 f"persistent part corruption: digest rejected "
                 f"{self.INTEGRITY_FREE_REJECTS + 1}+ times: {reason}")
 
+    def report_unsupported(self, shard_id: str, host: str,
+                           reason: str) -> None:
+        """A worker rejected the shard's SHAPE (an old daemon that
+        predates frame-band shards): a capability gap, not a fault —
+        the lease goes straight back with NO attempt burned, no
+        backoff and no quarantine accounting, and the shard stops
+        being offered to that host (`no_hosts`) so the rejection
+        cannot ping-pong between the same pair forever."""
+        requeued = False
+        with self._lock:
+            shard = self._find_locked(shard_id)
+            if shard is not None and shard.state is ShardState.ASSIGNED \
+                    and shard.assigned_host == host:
+                shard.state = ShardState.PENDING
+                shard.assigned_host = ""
+                shard.not_before = 0.0
+                if host not in shard.no_hosts:
+                    shard.no_hosts = shard.no_hosts + (host,)
+                job_id = shard.job_id
+                requeued = True
+        self.coordinator.activity.emit(
+            "shard-requeue",
+            f"shard {shard_id} shape rejected by {host or 'unknown'} "
+            f"(worker too old?): requeued with no attempt burned: "
+            f"{reason}", host=host)
+        if requeued:
+            self._restart_band_group(job_id)
+
+    def _restart_band_group(self, job_id: str) -> None:
+        """Band shards exchange halo rows in LOCKSTEP: when one of a
+        job's band shards falls back to PENDING (failure, expiry,
+        integrity reject, preemption, unsupported shape), its siblings
+        are blocked on exchanges that will never complete — requeue
+        them too. ASSIGNED siblings requeue with preemption semantics
+        (NO attempt burned, their late parts still land); DONE
+        siblings requeue with their spooled part RETRACTED (a finished
+        slice is useless without live peers to feed the re-encoder's
+        halo — the model-checked DONE→PENDING edge; the re-encode
+        deterministically re-submits identical bytes). The halo
+        generation bumps so in-flight workers of the old epoch see
+        `stale` and abandon cleanly (cluster/halo.py). A FAILED band
+        shard only bumps the generation: the job is failing, and
+        retracting its siblings' finished parts would just cost the
+        next resume."""
+        bumped = 0
+        requeued: list[tuple[str, str, str]] = []
+        retract: list[PartRef] = []
+        with self._lock:
+            entry = self._jobs.get(job_id)
+            if entry is None:
+                return
+            band = [s for s in entry.shards.values()
+                    if s.shape == "band"]
+            if not band:
+                return
+            restart = any(s.state is ShardState.PENDING for s in band)
+            if not restart:
+                if any(s.state is ShardState.FAILED for s in band):
+                    entry.halo_gen += 1
+                    bumped = entry.halo_gen
+                else:
+                    return
+            else:
+                for shard in band:
+                    if shard.state not in (ShardState.ASSIGNED,
+                                           ShardState.DONE):
+                        continue
+                    was = shard.state
+                    if was is ShardState.DONE and shard.part_path:
+                        retract.append(PartRef(
+                            job_id=job_id, key=shard.key or shard.id,
+                            path=shard.part_path,
+                            digests=shard.part_digests,
+                            nbytes=shard.part_bytes))
+                    shard.state = ShardState.PENDING
+                    host = shard.assigned_host or shard.finished_host
+                    shard.assigned_host = ""
+                    shard.not_before = 0.0
+                    shard.segments = []
+                    shard.part_path = ""
+                    shard.part_digests = ()
+                    shard.part_bytes = 0
+                    shard.finished_host = ""
+                    shard.resumed = False
+                    requeued.append((shard.id, host, was.value))
+                    if was is ShardState.ASSIGNED:
+                        self._preempted += 1
+                entry.halo_gen += 1
+                bumped = entry.halo_gen
+        self.halo.set_gen(job_id, bumped)
+        if retract:
+            # spool hygiene OUTSIDE the board lock (journal fsync):
+            # best-effort — an undropped record is re-verified (and
+            # dropped all-or-nothing) by any later resume anyway
+            parts = self.parts
+            for ref in retract:
+                try:
+                    parts.drop_done(job_id, ref.key, ref)
+                except Exception:   # noqa: BLE001 - hygiene only
+                    pass
+        for sid, host, was in requeued:
+            self.coordinator.activity.emit(
+                "shard-requeue",
+                f"band shard {sid} ({was}) requeued off "
+                f"{host or 'unknown'}: sibling band restarted the "
+                f"halo group (gen {bumped})",
+                job_id=job_id, host=host)
+
     def report_failure(self, shard_id: str, host: str, error: str) -> None:
         """Worker-reported failure OR lease expiry: requeue with backoff
         until the attempt budget burns out, then fail the job; count the
-        failure against the worker and quarantine a repeat offender."""
+        failure against the worker and quarantine a repeat offender.
+        A failed BAND shard additionally restarts its sibling band
+        group (lockstep halo exchange — see _restart_band_group)."""
         now = self._clock()
         co = self.coordinator
         with self._lock:
@@ -725,6 +950,7 @@ class ShardBoard:
                     * (2 ** (shard.attempt - 1))
             job_id = shard.job_id
             shard_tenant = shard.tenant
+            shard_is_band = shard.shape == "band"
             quarantine_after = entry.quarantine_after
             # capture under the lock: a concurrent claim can flip the
             # shard back to ASSIGNED before the emit below runs, which
@@ -740,6 +966,8 @@ class ShardBoard:
         obs_trace.TRACE.record_error(
             job_id, f"shard {shard_id} attempt {attempt_no} on "
                     f"{host or 'unknown'}: {error}")
+        if shard_is_band:
+            self._restart_band_group(job_id)
         if host:
             streak = co.registry.record_shard_result(host, ok=False)
             if streak >= quarantine_after:
@@ -798,6 +1026,7 @@ class ShardBoard:
         is wasted either. Counted in the snapshot's `preempted`
         figure. Returns the (shard id, evicted host) pairs."""
         requeued: list[tuple[str, str]] = []
+        band_jobs: set[str] = set()
         with self._lock:
             for entry in self._jobs.values():
                 for shard in entry.shards.values():
@@ -810,6 +1039,12 @@ class ShardBoard:
                     shard.not_before = 0.0
                     requeued.append((shard.id, host))
                     self._preempted += 1
+                    if shard.shape == "band":
+                        band_jobs.add(shard.job_id)
+        for jid in band_jobs:
+            # a preempted band shard strands its lockstep siblings:
+            # restart the group (and stale the halo epoch) together
+            self._restart_band_group(jid)
         return requeued
 
     def preempt_batch(self) -> int:
@@ -944,7 +1179,10 @@ class ShardBoard:
                 "resumed": resumed,
                 "integrity_rejects": integrity_rejects,
                 "spool_bytes": spool.spool_bytes()
-                if spool is not None else 0}
+                if spool is not None else 0,
+                # halo relay occupancy (cluster/halo.py): band-shard
+                # rendezvous blobs buffered on the coordinator
+                "halo": self.halo.snapshot()}
 
 
 class RemoteExecutor(LocalExecutor):
@@ -1087,9 +1325,88 @@ class RemoteExecutor(LocalExecutor):
     def _build_shards(self, job: Job, meta, num_frames: int,
                       settings, token: str = ""
                       ) -> tuple[SegmentPlan, list[Shard]]:
+        if self._band_shape(job, settings):
+            return self._build_band_shards(job, meta, num_frames,
+                                           settings, token=token)
         plan = self._plan_remote(num_frames, settings)
         return plan, self._shards_for(job, meta, plan, settings,
                                       qp=int(settings.qp), token=token)
+
+    @staticmethod
+    def _band_shape(job: Job, settings) -> bool:
+        """Plan frame-band shards (farm SFE) instead of GOP ranges?
+        `sfe_bands > 0` opts the job into split-frame encoding and
+        `sfe_farm` (default on) lets the remote backend spread the
+        bands across hosts; ladder/live jobs keep their existing shard
+        shapes (rung x range / local edge)."""
+        return (int(settings.get("sfe_bands", 0) or 0) > 0
+                and bool(settings.get("sfe_farm", True))
+                and getattr(job, "job_type", "transcode") == "transcode")
+
+    def _build_band_shards(self, job: Job, meta, num_frames: int,
+                           settings, token: str = ""
+                           ) -> tuple[SegmentPlan, list[Shard]]:
+        """Plan one frame-band shard per worker: a contiguous slice of
+        the job's global band layout covering EVERY GOP, encoded in
+        lockstep with the sibling slices (halo over the /work relay).
+        The band count CLAMPS to workers x min(worker devices): a
+        shard must never carry more bands than its host's mesh — a
+        mid-job dense fallback on the slowest worker would silently
+        serialize the whole group, so the plan refuses up front (WARN)
+        instead."""
+        from ..parallel.planner import plan_encode
+
+        workers = self._live_workers()
+        nworkers = max(1, len(workers))
+        dev_counts = [max(1, int(w.metrics.get("worker_devices", 1)
+                                 or 1)) for w in workers] or [1]
+        min_dev = min(dev_counts)
+        mbh = (meta.height + 15) // 16
+        requested = int(settings.get("sfe_bands", 0) or 0) \
+            or nworkers * min_dev
+        cap = nworkers * min_dev
+        if requested > cap:
+            self.coordinator.activity.emit(
+                "shard",
+                f"WARN: sfe_bands={requested} clamped to {cap} "
+                f"({nworkers} workers x {min_dev} devices on the "
+                f"slowest): a band shard must fit its host's mesh",
+                job_id=job.id, host=self.host)
+            requested = cap
+        eplan = plan_encode(
+            num_frames, settings, num_devices=nworkers, shape="band",
+            total_bands=min(requested, mbh), group_count=nworkers,
+            mb_height=mbh)
+        return eplan.segments, self._band_shards_for(
+            job, meta, eplan, settings, token=token)
+
+    def _band_shards_for(self, job: Job, meta, eplan, settings,
+                         token: str = "") -> list[Shard]:
+        from .qos import job_rank
+
+        seg = eplan.segments
+        priority = job_rank(
+            getattr(job, "job_type", "transcode"),
+            str(settings.get("job_priority", "auto") or "auto"))
+        trace_id = obs_trace.TRACE.trace_id(job.id)
+        run = f"{token[:6]}-" if token else ""
+        base_timeout = float(settings.remote_shard_timeout_s)
+        shards = []
+        for lo, hi in eplan.band_groups:
+            key = f"band{lo:03d}"
+            shards.append(Shard(
+                id=f"{job.id[:12]}-{run}{key}", key=key,
+                job_id=job.id, input_path=job.input_path, meta=meta,
+                gops=tuple(seg.gops), qp=int(settings.qp),
+                gop_frames=int(seg.frames_per_gop),
+                timeout_s=base_timeout * len(seg.gops),
+                shape="band", band_start=int(lo),
+                band_count=int(hi - lo),
+                total_bands=int(eplan.total_bands),
+                halo_rows=int(eplan.halo_rows),
+                priority=priority, trace_id=trace_id,
+                tenant=getattr(job, "tenant", "default") or "default"))
+        return shards
 
     # -- durable checkpoint / crash-resume (cluster/partstore.py) ------
 
@@ -1112,6 +1429,15 @@ class RemoteExecutor(LocalExecutor):
         if rungs:
             fields.extend(f"{r.name}:{r.width}x{r.height}@{r.qp}"
                           for r in rungs)
+        # band-shape knobs join the signature ONLY when SFE is on, so
+        # every pre-existing GOP-shaped checkpoint keeps its signature
+        # (a band-layout change MUST reset the checkpoint: the spooled
+        # parts' slice structure would no longer match the plan)
+        sfe_bands = int(settings.get("sfe_bands", 0) or 0)
+        if sfe_bands > 0:
+            fields.extend(["band", str(sfe_bands),
+                           str(int(settings.get("sfe_halo_rows", 32)
+                                   or 32))])
         return hashlib.sha256("|".join(fields).encode()).hexdigest()[:16]
 
     @staticmethod
@@ -1136,6 +1462,13 @@ class RemoteExecutor(LocalExecutor):
                 "timeout_s": float(s.timeout_s),
                 "rung": s.rung, "rung_width": int(s.rung_width),
                 "rung_height": int(s.rung_height),
+                # band shape (absent/"gop" on classic shards so old
+                # checkpoints replay unchanged)
+                "shape": s.shape,
+                "band_start": int(s.band_start),
+                "band_count": int(s.band_count),
+                "total_bands": int(s.total_bands),
+                "halo_rows": int(s.halo_rows),
             } for s in shards],
         }
 
@@ -1174,6 +1507,11 @@ class RemoteExecutor(LocalExecutor):
                 rung=str(srec.get("rung", "")),
                 rung_width=int(srec.get("rung_width", 0)),
                 rung_height=int(srec.get("rung_height", 0)),
+                shape=str(srec.get("shape", "gop") or "gop"),
+                band_start=int(srec.get("band_start", 0)),
+                band_count=int(srec.get("band_count", 0)),
+                total_bands=int(srec.get("total_bands", 0)),
+                halo_rows=int(srec.get("halo_rows", 0)),
                 priority=priority, trace_id=trace_id,
                 tenant=getattr(job, "tenant", "default") or "default"))
         return plan, shards
@@ -1217,7 +1555,32 @@ class RemoteExecutor(LocalExecutor):
             rec = self._plan_record(sig, plan, shards)
         refs = parts.begin_job(job.id, rec)
         reused = 0
-        if resume:
+        if resume and shards and shards[0].shape == "band":
+            # band groups resume ALL-OR-NOTHING: a partially-resumed
+            # group would strand the re-encoding shard waiting on halo
+            # exchanges its DONE siblings will never send. Either every
+            # band shard's part verifies (whole job rehydrates — no
+            # encode at all) or none does (whole group re-encodes).
+            verified = {s.key: refs[s.key] for s in shards
+                        if refs.get(s.key) is not None
+                        and parts.verify_part(refs[s.key])}
+            if len(verified) == len(shards):
+                for shard in shards:
+                    self.board.rehydrate_done(shard, verified[shard.key])
+                    reused += 1
+            else:
+                for shard in shards:
+                    ref = refs.get(shard.key)
+                    if ref is not None:
+                        parts.drop_done(job.id, shard.key, ref)
+                if verified:
+                    co.activity.emit(
+                        "resume",
+                        f"band group resume is all-or-nothing: "
+                        f"{len(verified)}/{len(shards)} parts verified "
+                        f"— dropping them, the group re-encodes in "
+                        f"lockstep", job_id=job.id, host=self.host)
+        elif resume:
             for shard in shards:
                 ref = refs.get(shard.key)
                 if ref is None:
@@ -1312,21 +1675,31 @@ class RemoteExecutor(LocalExecutor):
         stage[0] = "segment"
         plan, shards, reused = self._plan_or_resume(
             job, token, settings, meta, len(frames))
-        co.update_progress(job.id, token, parts_total=plan.num_gops,
+        banded = bool(shards) and shards[0].shape == "band"
+        parts_total = plan.num_gops * (len(shards) if banded else 1)
+        co.update_progress(job.id, token, parts_total=parts_total,
                            segment_progress=100.0)
-        co.heartbeat_job(
-            job.id, token, stage[0], host=self.host,
-            note=f"{plan.num_gops} GOPs in {len(shards)} shards")
+        if banded:
+            note = (f"{plan.num_gops} GOPs x {len(shards)} band "
+                    f"slices (farm SFE, {shards[0].total_bands} bands)")
+            act = note
+        else:
+            note = f"{plan.num_gops} GOPs in {len(shards)} shards"
+            act = f"{plan.num_gops} GOPs as {len(shards)} shards"
+        co.heartbeat_job(job.id, token, stage[0], host=self.host,
+                         note=note)
         co.activity.emit(
-            "shard", f"dispatching {plan.num_gops} GOPs as "
-            f"{len(shards)} shards to the worker farm"
+            "shard", f"dispatching {act} to the worker farm"
             + (f" ({reused} resumed from the spool)" if reused else ""),
             job_id=job.id, host=self.host)
 
         stage[0] = "encode"
-        segments = [seg for shard in self._drain_board(job, token,
-                                                       settings, shards)
-                    for seg in shard.segments]
+        done_shards = self._drain_board(job, token, settings, shards)
+        if banded:
+            segments = stitch_band_shards(done_shards)
+        else:
+            segments = [seg for shard in done_shards
+                        for seg in shard.segments]
         segments.sort(key=lambda s: s.gop.index)
         return segments
 
@@ -1336,50 +1709,171 @@ class RemoteExecutor(LocalExecutor):
         DONE: lease sweeps, progress writes (only on change — the store
         is journal-backed), the all-workers-dead failsafe, and
         token-fenced cleanup. Returns the completed shard records."""
-        co = self.coordinator
         self.board.add_job(
             job.id, shards,
             max_attempts=int(settings.part_failure_max_retries),
             backoff_s=float(settings.remote_retry_backoff_s),
             quarantine_after=int(settings.remote_worker_max_failures),
             token=token)
+        try:
+            return self._wait_board(job, token, settings)
+        finally:
+            self.board.cancel_job(job.id, token=token)
+
+    def _wait_board(self, job: Job, token: str, settings,
+                    report_progress: bool = True) -> list[Shard]:
+        """Babysit the posted board entry to completion (the shared
+        tail of _drain_board and the live catch-up fan-out, which owns
+        its board entry's lifecycle — and its progress counters)."""
+        co = self.coordinator
         grace = float(settings.remote_no_worker_grace_s)
         workerless_since: float | None = None
         last_progress = (-1, -1)
+        while True:
+            if not co.token_is_current(job.id, token):
+                raise HaltedError("stale run token")
+            self.board.requeue_expired()
+            done, total, retried, failed, failed_host = \
+                self.board.job_progress(job.id)
+            if report_progress and (done, retried) != last_progress:
+                last_progress = (done, retried)
+                co.update_progress(
+                    job.id, token, parts_done=done,
+                    parts_retried=retried,
+                    encode_progress=100.0 * done / max(1, total))
+            if failed:
+                raise RuntimeError(failed)
+            if done >= total:
+                return self.board.take_shards(job.id, token=token)
+            live = self._live_workers()
+            if live:
+                workerless_since = None
+            else:
+                now = self._clock()
+                if workerless_since is None:
+                    workerless_since = now
+                elif now - workerless_since > grace:
+                    raise RuntimeError(
+                        f"no live encode workers for {grace:.0f}s; "
+                        f"{total - done} GOPs stranded")
+            co.heartbeat_job(
+                job.id, token, "encode", host=self.host,
+                note=f"{done}/{total} GOPs on {len(live)} workers")
+            time.sleep(self.poll_s)
+
+    # -- live catch-up fan-out -----------------------------------------
+
+    #: minimum whole backlog GOPs (beyond the live-edge GOP kept
+    #: local) before a live batch fans across the farm: a one-GOP
+    #: round-trip would put worker latency inside the glass-to-
+    #: playlist path for nothing
+    LIVE_FARM_MIN_GOPS = 2
+
+    def _live_backlog_cap(self, job, settings, enc) -> int:
+        """Catch-up batches may span the whole farm's width, not just
+        the local mesh: the farm absorbs the backlog while the edge
+        GOP encodes locally. When the fan-out cannot engage (knob off,
+        direct-mode job), the LOCAL wave bound stays in force — an
+        inflated batch would otherwise serialize whole farm-widths of
+        GOPs through the local mesh before the packager sees a part."""
+        base = enc.num_devices * enc.gops_per_wave
+        if not bool(settings.get("live_farm_catchup", True))                 or str(getattr(job, "processing_mode", "split")
+                       or "split") == "direct":
+            return base
+        return base * max(1, len(self._live_workers()))
+
+    def _live_encode_batch(self, job: Job, token: str, settings, enc,
+                           rungs, tail, frames_done: int,
+                           gops_done: int, count: int, gop_n: int,
+                           sfe_live: bool):
+        """Fan a live job's catch-up GOPs across the farm while the
+        NEWEST GOP (the live edge) encodes on the coordinator mesh —
+        the farm eats the backlog concurrently with the edge, so one
+        host's throughput no longer bounds how fast a live stream
+        recovers. Small batches (the steady live edge) stay entirely
+        local: a worker round-trip inside the glass-to-playlist path
+        would only add latency."""
+        from ..abr.ladder import LadderGopBundle
+
+        workers = self._live_workers()
+        farm_gops = count // gop_n - 1      # newest GOP stays local
+        if (not bool(settings.get("live_farm_catchup", True))
+                or not workers
+                or farm_gops < self.LIVE_FARM_MIN_GOPS
+                or str(getattr(job, "processing_mode", "split")
+                       or "split") == "direct"):
+            return super()._live_encode_batch(
+                job, token, settings, enc, rungs, tail, frames_done,
+                gops_done, count, gop_n, sfe_live)
+        co = self.coordinator
+        farm_frames = farm_gops * gop_n
+        plan = SegmentPlan(
+            gops=tuple(GopSpec(index=gops_done + i,
+                               start_frame=frames_done + i * gop_n,
+                               num_frames=gop_n)
+                       for i in range(farm_gops)),
+            num_devices=max(1, len(workers)), frames_per_gop=gop_n)
+        shards: list[Shard] = []
+        for rung in rungs:
+            shards.extend(self._shards_for(job, tail.meta, plan,
+                                           settings, qp=rung.qp,
+                                           rung=rung, token=token))
+        co.activity.emit(
+            "shard", f"live catch-up: farming {farm_gops} backlog "
+            f"GOPs x {len(rungs)} rungs across {len(workers)} workers "
+            f"while the edge encodes locally",
+            job_id=job.id, host=self.host)
+        self.board.add_job(
+            job.id, shards,
+            max_attempts=int(settings.part_failure_max_retries),
+            backoff_s=float(settings.remote_retry_backoff_s),
+            quarantine_after=int(settings.remote_worker_max_failures),
+            token=token)
         try:
-            while True:
-                if not co.token_is_current(job.id, token):
-                    raise HaltedError("stale run token")
-                self.board.requeue_expired()
-                done, total, retried, failed, failed_host = \
-                    self.board.job_progress(job.id)
-                if (done, retried) != last_progress:
-                    last_progress = (done, retried)
-                    co.update_progress(
-                        job.id, token, parts_done=done,
-                        parts_retried=retried,
-                        encode_progress=100.0 * done / max(1, total))
-                if failed:
-                    raise RuntimeError(failed)
-                if done >= total:
-                    return self.board.take_shards(job.id, token=token)
-                live = self._live_workers()
-                if live:
-                    workerless_since = None
-                else:
-                    now = self._clock()
-                    if workerless_since is None:
-                        workerless_since = now
-                    elif now - workerless_since > grace:
-                        raise RuntimeError(
-                            f"no live encode workers for {grace:.0f}s; "
-                            f"{total - done} GOPs stranded")
-                co.heartbeat_job(
-                    job.id, token, "encode", host=self.host,
-                    note=f"{done}/{total} GOPs on {len(live)} workers")
-                time.sleep(self.poll_s)
+            # edge GOP (+ any EOS partial tail) locally, farm in flight
+            local = super()._live_encode_batch(
+                job, token, settings, enc, rungs, tail,
+                frames_done + farm_frames, gops_done + farm_gops,
+                count - farm_frames, gop_n, sfe_live)
+            try:
+                done_shards = self._wait_board(job, token, settings,
+                                               report_progress=False)
+            except HaltedError:
+                raise
+            except RuntimeError as exc:
+                # the farm died under the catch-up batch (shard budget
+                # burned, all workers dark): a live stream must not
+                # fail for it — nothing was consumed yet, so encode
+                # the span locally (deterministic: identical bytes)
+                co.activity.emit(
+                    "shard", f"live catch-up farm failed ({exc}); "
+                    f"re-encoding the {farm_gops}-GOP span locally",
+                    job_id=job.id, host=self.host)
+                self.board.cancel_job(job.id, token=token)
+                return super()._live_encode_batch(
+                    job, token, settings, enc, rungs, tail,
+                    frames_done, gops_done, farm_frames, gop_n,
+                    sfe_live) + local
         finally:
             self.board.cancel_job(job.id, token=token)
+        by_gop: dict[int, dict] = {}
+        gop_of: dict[int, GopSpec] = {}
+        for shard in done_shards:
+            for seg in shard.segments:
+                name = shard.rung or rungs[0].name
+                by_gop.setdefault(seg.gop.index, {})[name] = seg
+                gop_of[seg.gop.index] = seg.gop
+        farm_bundles = [
+            LadderGopBundle(gop=gop_of[i], renditions=by_gop[i])
+            for i in sorted(by_gop)]
+        for b in farm_bundles:
+            missing = [r.name for r in rungs
+                       if r.name not in b.renditions]
+            if missing:
+                raise RuntimeError(
+                    f"live catch-up GOP {b.gop.index} missing rungs "
+                    f"{missing}")
+        return farm_bundles + local
 
     def _encode_ladder(self, job: Job, token: str, frames, settings,
                        meta, stage: list):
@@ -1425,13 +1919,117 @@ class RemoteExecutor(LocalExecutor):
         return rungs, by_rung
 
 
+def stitch_band_shards(shards: Iterable[Shard]) -> list[EncodedSegment]:
+    """Zip a band-sharded job's per-GOP slice streams back into whole
+    pictures: for every GOP, frame f's access unit is the concat of
+    every band group's frame-f slice bytes in band order (group 0
+    carries the SPS/PPS prefix on IDR frames). Byte-identical to what
+    a local-mesh SfeShardEncoder with the same global band layout
+    emits — the downstream stitch/mux path needs no band awareness."""
+    groups = sorted((s for s in shards if s.shape == "band"),
+                    key=lambda s: s.band_start)
+    if not groups:
+        return []
+    per = [{seg.gop.index: seg for seg in s.segments} for s in groups]
+    indices = sorted(per[0])
+    out: list[EncodedSegment] = []
+    for gi in indices:
+        segs = []
+        for p, s in zip(per, groups):
+            if gi not in p:
+                raise ValueError(
+                    f"band shard {s.id} is missing GOP {gi}")
+            segs.append(p[gi])
+        nframes = {len(s.frame_sizes) for s in segs}
+        if len(nframes) != 1:
+            raise ValueError(
+                f"band shards disagree on GOP {gi}'s frame count: "
+                f"{sorted(len(s.frame_sizes) for s in segs)}")
+        payload = bytearray()
+        sizes = []
+        offs = [0] * len(segs)
+        for f in range(nframes.pop()):
+            total = 0
+            for k, seg in enumerate(segs):
+                sz = seg.frame_sizes[f]
+                payload += seg.payload[offs[k]:offs[k] + sz]
+                offs[k] += sz
+                total += sz
+            sizes.append(total)
+        out.append(EncodedSegment(gop=segs[0].gop,
+                                  payload=bytes(payload),
+                                  frame_sizes=tuple(sizes)))
+    return out
+
+
 # ---------------------------------------------------------------------------
 # worker side
 # ---------------------------------------------------------------------------
 
 
-def encode_shard(desc: Mapping[str, Any], frames, mesh=None, tracer=None
-                 ) -> list[EncodedSegment]:
+class UnsupportedShardShape(RuntimeError):
+    """The claim descriptor carries a shard shape this worker does not
+    implement (version skew on a rolling upgrade): reported as
+    `unsupported` so the board requeues with NO attempt burned and
+    stops offering the shard to this host."""
+
+
+def _encode_band_shard(desc: Mapping[str, Any], frames, mesh=None,
+                       tracer=None, halo_transport=None
+                       ) -> list[EncodedSegment]:
+    """Encode one frame-band shard: this host owns bands
+    [start, start+count) of the job's `total`-band layout, steps the
+    shard's whole GOP walk in lockstep with the sibling groups, and
+    exchanges per-frame halo rows / probe partials / histogram
+    partials through `halo_transport` (cluster/halo.py — the
+    coordinator-relayed route). Bit-identity contract as
+    parallel/sfefarm.py documents."""
+    from ..core.config import get_settings
+    from ..parallel.dispatch import make_shard_encoder
+    from .halo import HaloSession
+
+    meta = meta_from_dict(desc["meta"])
+    gops = tuple(GopSpec(index=int(i), start_frame=int(s),
+                         num_frames=int(n))
+                 for i, s, n in desc["gops"])
+    band = desc.get("band") or {}
+    lo = int(band.get("start", 0))
+    cnt = max(1, int(band.get("count", 1) or 1))
+    total = int(band.get("total", 0) or 0) or (lo + cnt)
+    groups = [(int(a), int(b))
+              for a, b in (band.get("groups") or [[lo, lo + cnt]])]
+    session = None
+    if len(groups) > 1:
+        if halo_transport is None:
+            raise ValueError(
+                "band shard has sibling groups but no halo transport")
+        session = HaloSession(halo_transport, band_lo=lo,
+                              band_hi=lo + cnt, groups=groups)
+    enc = make_shard_encoder(
+        meta, get_settings(), mesh, shape="band",
+        qp=int(desc["qp"]), total_bands=total,
+        band_range=(lo, lo + cnt),
+        halo_rows=int(band.get("halo_rows", 32) or 32),
+        session=session)
+    if tracer is not None:
+        enc.stages.set_tracer(tracer)
+    enc.plan_override = SegmentPlan(
+        gops=gops, num_devices=enc.num_devices,
+        frames_per_gop=int(desc.get("gop_frames", 32)))
+    enc.gop_index_offset = int(desc["gop_index_offset"])
+    enc.frame_offset = int(desc["start_frame"])
+    f0 = int(desc["start_frame"])
+    sub = frames[f0:f0 + int(desc["num_frames"])]
+    if len(sub) != int(desc["num_frames"]):
+        raise ValueError(
+            f"{desc['input_path']}: band shard wants frames "
+            f"[{f0}, {f0 + int(desc['num_frames'])}) but clip has "
+            f"{len(frames)}")
+    return enc.encode(sub)
+
+
+def encode_shard(desc: Mapping[str, Any], frames, mesh=None, tracer=None,
+                 halo_transport=None) -> list[EncodedSegment]:
     """Encode one claimed shard on this process's devices. Pure w.r.t.
     the descriptor: the plan override pins the coordinator's exact GOP
     boundaries and the index/frame offsets re-base the emitted segments
@@ -1456,6 +2054,13 @@ def encode_shard(desc: Mapping[str, Any], frames, mesh=None, tracer=None
     fetch/pack stages become spans in the job's distributed trace."""
     from ..parallel.dispatch import GopShardEncoder
 
+    shape = str(desc.get("shape", "gop") or "gop")
+    if shape == "band":
+        return _encode_band_shard(desc, frames, mesh=mesh, tracer=tracer,
+                                  halo_transport=halo_transport)
+    if shape != "gop":
+        raise UnsupportedShardShape(
+            f"shard shape {shape!r} not implemented by this worker")
     meta = meta_from_dict(desc["meta"])
     gops = tuple(GopSpec(index=int(i), start_frame=int(s),
                          num_frames=int(n))
@@ -1595,9 +2200,11 @@ class WorkerClient:
             }).encode(), "application/json", trace_id=trace_id)
         return int(out.get("recorded", 0))
 
-    def report_failure(self, shard_id: str, host: str, error: str) -> None:
+    def report_failure(self, shard_id: str, host: str, error: str,
+                       unsupported: bool = False) -> None:
         self._request("/work/status", json.dumps({
             "shard_id": shard_id, "host": host, "ok": False,
+            "unsupported": bool(unsupported),
             "error": error[:500]}).encode(), "application/json")
 
 
@@ -1630,6 +2237,7 @@ class WorkerDaemon:
         self.busy = False
         self.shards_done = 0
         self.shards_failed = 0
+        self._device_count: int | None = None
         #: input_path → (signature, opened FrameSource — no decoded
         #: frames cached; shards range-decode on demand)
         self._cache: dict[str, tuple[str, Any]] = {}
@@ -1637,7 +2245,23 @@ class WorkerDaemon:
     # -- metrics seam (NodeAgent extra_metrics) ------------------------
 
     def metrics(self) -> dict[str, Any]:
+        if self._device_count is None:
+            # lazy, once: the heartbeat advertises this host's device
+            # mesh width so the coordinator's band planner can clamp a
+            # shard's band count to the SLOWEST worker's devices (a
+            # worker is a jax process by definition — initializing the
+            # backend here only front-loads what the first claim does)
+            try:
+                if self.mesh is not None:
+                    self._device_count = int(self.mesh.devices.size)
+                else:
+                    import jax
+
+                    self._device_count = len(jax.devices())
+            except Exception:   # noqa: BLE001 - degraded heartbeat
+                self._device_count = 1
         return {"worker": True, "worker_busy": self.busy,
+                "worker_devices": self._device_count,
                 "worker_shards_done": self.shards_done,
                 "worker_shards_failed": self.shards_failed}
 
@@ -1674,6 +2298,8 @@ class WorkerDaemon:
         stage clocks, part upload) collect in a local SpanBuffer and
         ship to the coordinator's trace ring afterwards — best-effort,
         never part of the shard's success or failure."""
+        from .halo import HaloClient, HaloStaleError
+
         shard = self.client.claim(self.host)
         if not shard:
             return False
@@ -1684,6 +2310,12 @@ class WorkerDaemon:
         # inert recorder when untraced: span() is a no-op context, so
         # the work loop below stays unconditional
         sink = buf if buf is not None else obs_trace.NULL_RECORDER
+        halo_transport = None
+        if str(shard.get("shape", "gop") or "gop") == "band":
+            band = shard.get("band") or {}
+            halo_transport = HaloClient(
+                self.client.base, str(shard.get("job_id", "")),
+                int(band.get("gen", 1) or 1))
         self.busy = True
         try:
             with sink.span("worker_shard", shard=shard["id"],
@@ -1691,7 +2323,8 @@ class WorkerDaemon:
                 with sink.span("open_source"):
                     frames = self._frames(shard["input_path"])
                 segments = encode_shard(shard, frames, mesh=self.mesh,
-                                        tracer=buf)
+                                        tracer=buf,
+                                        halo_transport=halo_transport)
                 # the board may refuse the part (lease moved on, job
                 # gone): only an ACCEPTED part counts toward the gauge
                 with sink.span("upload_part"):
@@ -1699,6 +2332,17 @@ class WorkerDaemon:
                         shard["id"], self.host, segments)
             if accepted:
                 self.shards_done += 1
+        except HaloStaleError:
+            # the band group restarted under a newer halo generation:
+            # the board already took this lease back (sibling requeue),
+            # so abandon silently — not a failure, nothing to report
+            pass
+        except UnsupportedShardShape as exc:
+            try:
+                self.client.report_failure(
+                    shard["id"], self.host, str(exc), unsupported=True)
+            except Exception:       # noqa: BLE001 - coordinator gone;
+                pass                # the lease sweep requeues the shard
         except Exception as exc:    # noqa: BLE001 - report, keep serving
             self.shards_failed += 1
             try:

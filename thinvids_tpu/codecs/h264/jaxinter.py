@@ -455,16 +455,26 @@ def sfe_intra_band_dense(y, u, v, qp, real_rows, *, mbw: int,
 
 
 def sfe_p_band(y, u, v, carry, qp, real_rows, *, mbw: int, mbh_band: int,
-               halo_rows: int, num_bands: int, axis_name):
+               halo_rows: int, num_bands: int, axis_name, ext=None,
+               edge_top: bool = True, edge_bot: bool = True, probe=None,
+               return_hist: bool = False):
     """One band's P step: banded motion search (halo exchange + psum'd
     global centers/median, jaxme.me_search_banded) + the shared
     residual core, emitting PLANE-layout levels for the per-frame
     sparse transfer.
 
+    Farm mode (parallel/sfefarm.py): `ext`/`edge_top`/`edge_bot`
+    inject the cross-HOST neighbor reference rows, `probe` the
+    host-resolved global probe center, and `return_hist=True` returns
+    the per-host histogram partial instead of the on-device median
+    (the host finishes it across peers and feeds it back as the next
+    frame's `pred_mv`).
+
     Returns (mv8 (nmb, 2) int8, flat int16 [luma plane | u dc | v dc |
     u ac | v ac] — a single-frame slice of encode_gop_planes' P layout,
     so layout.unflatten_p_planes(flat, mv8, 2, ...) is the host
-    inverse), plus the chained (ry, ru, rv, med_mv) carry."""
+    inverse), plus the chained (ry, ru, rv, med_mv) carry; with
+    `return_hist` the tail is (cnt, n, (ry, ru, rv, pred_mv))."""
     if 2 * SEARCH_RANGE > 127:
         raise ValueError("SEARCH_RANGE exceeds the int8 MV transfer")
     ry, ru, rv, pred_mv = carry
@@ -473,9 +483,15 @@ def sfe_p_band(y, u, v, carry, qp, real_rows, *, mbw: int, mbh_band: int,
     cy16 = y.astype(jnp.int16)
     cu16 = u.astype(jnp.int16)
     cv16 = v.astype(jnp.int16)
-    mv, py, pu, pv, med = jaxme.me_search_banded(
+    out = jaxme.me_search_banded(
         cy16, ry, ru, rv, pred_mv, qp32, halo_rows=halo_rows,
-        num_bands=num_bands, axis_name=axis_name, real_rows=real_rows)
+        num_bands=num_bands, axis_name=axis_name, real_rows=real_rows,
+        ext=ext, edge_top=edge_top, edge_bot=edge_bot, probe=probe,
+        return_hist=return_hist)
+    if return_hist:
+        mv, py, pu, pv, cnt, n = out
+    else:
+        mv, py, pu, pv, med = out
     (lp, cdc, cac, ry2, ru2, rv2) = _residual_p(
         cy16, cu16, cv16, py, pu, pv, qp32, qpc, mbw=mbw, mbh=mbh_band,
         blocked=False)
@@ -487,4 +503,9 @@ def sfe_p_band(y, u, v, carry, qp, real_rows, *, mbw: int, mbh_band: int,
         cdc[0].reshape(-1), cdc[1].reshape(-1),
         cac[0].reshape(-1), cac[1].reshape(-1)])
     mv8 = mv.reshape(-1, 2).astype(jnp.int8)
+    if return_hist:
+        # the host owns the median in farm mode: carry the INPUT pred
+        # (ignored — the next step receives the cross-host median as a
+        # fresh input) so the carry shape matches the local chain's
+        return mv8, flat, cnt, n, (ry2, ru2, rv2, pred_mv)
     return mv8, flat, (ry2, ru2, rv2, med)

@@ -759,13 +759,30 @@ def halo_clamp(halo_rows: int) -> int:
     return max(0, min(_CLIM, ((halo_rows - _WR - 3) // 2) * 2))
 
 
-def band_halo_exchange(plane, halo: int, axis_name, num_bands: int):
+def band_halo_exchange(plane, halo: int, axis_name, num_bands: int,
+                       top_ext=None, bot_ext=None,
+                       edge_top: bool = True, edge_bot: bool = True):
     """(Hb, W) band plane → (Hb + 2*halo, W) extended with `halo` REAL
     rows from each neighbor band via `lax.ppermute`; the mesh-edge
     bands (no neighbor) edge-replicate their own boundary row, exactly
     matching the full-frame search's edge padding. `axis_name=None` (or
     one band) degrades to pure edge replication — the single-device
-    form of the same program."""
+    form of the same program.
+
+    Farm mode (cross-HOST bands, parallel/sfefarm.py): when this mesh
+    only holds a CONTIGUOUS SLICE of the global band layout, the
+    neighbor rows of the slice-edge bands live on another host and
+    arrive as host-injected `top_ext` / `bot_ext` (halo, W) arrays
+    (each band's shard of a band-sharded input; only the edge bands'
+    slices are read). `edge_top=False` means the global layout
+    continues above this slice — the first local band uses `top_ext`
+    instead of edge replication — and symmetrically for `edge_bot`.
+    The edge flags may be TRACED bool scalars (the farm steps pass
+    them as inputs, not static args, so one compiled program serves a
+    slice at ANY position — a worker re-claiming a different band
+    slice must not recompile its whole step set). With the defaults
+    the function is byte-identical to the original local-mesh
+    exchange."""
     H, W = plane.shape
     if halo > H and axis_name is not None and num_bands > 1:
         # one ppermute hop reaches ONE neighbor: a halo deeper than the
@@ -775,8 +792,12 @@ def band_halo_exchange(plane, halo: int, axis_name, num_bands: int):
         raise ValueError(f"halo {halo} exceeds band height {H}")
     top_edge = jnp.broadcast_to(plane[:1], (halo, W))
     bot_edge = jnp.broadcast_to(plane[H - 1:], (halo, W))
+    first_src = top_edge if top_ext is None \
+        else jnp.where(edge_top, top_edge, top_ext)
+    last_src = bot_edge if bot_ext is None \
+        else jnp.where(edge_bot, bot_edge, bot_ext)
     if axis_name is None or num_bands <= 1:
-        return jnp.concatenate([top_edge, plane, bot_edge])
+        return jnp.concatenate([first_src, plane, last_src])
     down = [(i, i + 1) for i in range(num_bands - 1)]
     up = [(i + 1, i) for i in range(num_bands - 1)]
     # band b's top halo = band b-1's bottom rows; bottom halo = band
@@ -785,21 +806,29 @@ def band_halo_exchange(plane, halo: int, axis_name, num_bands: int):
     recv_top = jax.lax.ppermute(plane[H - halo:], axis_name, down)
     recv_bot = jax.lax.ppermute(plane[:halo], axis_name, up)
     idx = jax.lax.axis_index(axis_name)
-    top = jnp.where(idx == 0, top_edge, recv_top)
-    bot = jnp.where(idx == num_bands - 1, bot_edge, recv_bot)
+    top = jnp.where(idx == 0, first_src, recv_top)
+    bot = jnp.where(idx == num_bands - 1, last_src, recv_bot)
     return jnp.concatenate([top, plane, bot])
 
 
-def banded_coarse_probe(cur16, ref16, real_rows, axis_name,
-                        num_bands: int, sr: int = SEARCH_RANGE):
-    """`coarse_probe` decomposed across bands: each band contributes
-    the partial SAD of its REAL rows for every candidate window (halo
-    cells arrive from the neighbors at quarter-res granularity, so the
-    window slices see exactly the full-frame probe's padded plane) and
-    the per-window costs psum — the argmin is the SAME global-motion
-    center on every band. `real_rows` masks the last band's padding
-    rows out of the cost, keeping the sums equal to the full-frame
-    probe's."""
+def banded_probe_cost(cur16, ref16, real_rows, axis_name,
+                      num_bands: int, sr: int = SEARCH_RANGE,
+                      top_ext=None, bot_ext=None,
+                      edge_top: bool = True, edge_bot: bool = True):
+    """The probe's per-window cost vector, psum'd over THIS mesh's
+    bands: each band contributes the partial SAD of its REAL rows for
+    every candidate window (halo cells arrive from the neighbors at
+    quarter-res granularity, so the window slices see exactly the
+    full-frame probe's padded plane). `real_rows` masks the last
+    band's padding rows out of the cost, keeping the sums equal to the
+    full-frame probe's.
+
+    Farm mode: `top_ext`/`bot_ext` are host-injected neighbor
+    reference PIXEL rows (≥ 16 per side) from the adjacent band slice
+    on another host; their quarter-res cells substitute for the
+    ppermute halo at the slice edges, so the partial sums of every
+    host add up to exactly the full-mesh psum. The caller finishes the
+    cross-host reduction and argmin (probe_center_from_cost)."""
     qs = _COARSE
     qsr = sr // qs
     cq = _box_sum(cur16, qs)
@@ -812,7 +841,17 @@ def banded_coarse_probe(cur16, ref16, real_rows, axis_name,
     # anyway and (b) the halo cells it SENDS (and its own bottom edge
     # replication) equal the full-frame probe's bottom edge padding.
     rq = jnp.take(rq, jnp.minimum(rows, real_c - 1), axis=0)
-    rq_ext = band_halo_exchange(rq, qsr, axis_name, num_bands)
+    # the injected neighbor rows are raw recon pixels (never a padded
+    # band — only the global-last band pads, and it has no neighbor
+    # below), so their box sums equal the neighbor's own unclamped
+    # cells bit for bit
+    top_cells = _box_sum(top_ext, qs)[-qsr:] if top_ext is not None \
+        else None
+    bot_cells = _box_sum(bot_ext, qs)[:qsr] if bot_ext is not None \
+        else None
+    rq_ext = band_halo_exchange(rq, qsr, axis_name, num_bands,
+                                top_ext=top_cells, bot_ext=bot_cells,
+                                edge_top=edge_top, edge_bot=edge_bot)
     rq_ext = jnp.pad(rq_ext, ((0, 0), (qsr, qsr)), mode="edge")
     mask = (rows < real_c)[:, None]
     n = 2 * qsr + 1
@@ -821,19 +860,51 @@ def banded_coarse_probe(cur16, ref16, real_rows, axis_name,
     cost = (jnp.abs(cq[None] - wins) * mask[None]).sum((1, 2))
     if axis_name is not None and num_bands > 1:
         cost = jax.lax.psum(cost, axis_name)
+    return cost
+
+
+def banded_coarse_probe(cur16, ref16, real_rows, axis_name,
+                        num_bands: int, sr: int = SEARCH_RANGE):
+    """`coarse_probe` decomposed across bands: the psum'd per-window
+    cost (banded_probe_cost) argmin'd — the SAME global-motion center
+    on every band."""
+    qs = _COARSE
+    qsr = sr // qs
+    n = 2 * qsr + 1
+    cost = banded_probe_cost(cur16, ref16, real_rows, axis_name,
+                             num_bands, sr=sr)
     bi = jnp.argmin(cost).astype(jnp.int32)
     return jnp.stack([bi // n - qsr, bi % n - qsr]) * qs
 
 
+def probe_center_from_cost(cost, sr: int = SEARCH_RANGE):
+    """Host-side tail of the split probe (numpy): argmin the summed
+    per-window costs into the (2,) pel center — the exact mirror of
+    banded_coarse_probe's device argmin (both resolve ties to the
+    first minimum), run by the farm coordinator thread after the
+    cross-host partial-cost reduction."""
+    import numpy as _np
+
+    qs = _COARSE
+    qsr = sr // qs
+    n = 2 * qsr + 1
+    bi = int(_np.argmin(_np.asarray(cost)))
+    return _np.asarray([bi // n - qsr, bi % n - qsr], _np.int32) * qs
+
+
 def banded_centers_from(cur16, ref16, pred_mv_h, real_rows,
-                        halo_rows: int, axis_name, num_bands: int):
+                        halo_rows: int, axis_name, num_bands: int,
+                        probe=None):
     """(3, 2) even-pel centers for one band's search: psum'd probe,
     carried global median, zero — the banded mirror of `centers_from`,
     with the vertical component additionally clamped to
     `halo_clamp(halo_rows)` so every candidate read stays inside the
-    exchanged halo."""
-    probe = banded_coarse_probe(cur16, ref16, real_rows, axis_name,
-                                num_bands)
+    exchanged halo. `probe` injects a pre-computed (unclamped) global
+    center — the farm path, where the probe's cross-host psum resolves
+    on the host (probe_center_from_cost) before the search program."""
+    if probe is None:
+        probe = banded_coarse_probe(cur16, ref16, real_rows, axis_name,
+                                    num_bands)
     med_pel = jnp.clip((pred_mv_h + 2) >> 2, -(_CLIM // 2),
                        _CLIM // 2) * 2
     lims = jnp.asarray([min(halo_clamp(halo_rows), _CLIM), _CLIM],
@@ -845,12 +916,14 @@ def banded_centers_from(cur16, ref16, pred_mv_h, real_rows,
     return jnp.stack([probe, med_pel, zero])
 
 
-def hist_median_banded(mv_flat, mb_mask, lim: int, axis_name,
+def hist_counts_banded(mv_flat, mb_mask, lim: int, axis_name,
                        num_bands: int):
-    """`hist_median` decomposed across bands: per-band histogram counts
-    over the REAL macroblocks psum before the cumsum/argmax, so every
-    band carries the same global median (the next frame's temporal
-    search center)."""
+    """Per-band MV histogram counts over the REAL macroblocks, psum'd
+    over THIS mesh's bands: (2*lim+1, 2) counts + the masked MB count.
+    The local path feeds them straight into the cumsum/argmax
+    (hist_median_banded); the farm path ships each host's partial to
+    its peers and finishes the median on the host
+    (median_from_counts)."""
     bins = jnp.arange(-lim, lim + 1)
     cnt = ((mv_flat[:, None, :] == bins[None, :, None])
            & mb_mask[:, None, None]).sum(0)
@@ -858,13 +931,38 @@ def hist_median_banded(mv_flat, mb_mask, lim: int, axis_name,
     if axis_name is not None and num_bands > 1:
         cnt = jax.lax.psum(cnt, axis_name)
         n = jax.lax.psum(n, axis_name)
+    return cnt, n
+
+
+def hist_median_banded(mv_flat, mb_mask, lim: int, axis_name,
+                       num_bands: int):
+    """`hist_median` decomposed across bands: per-band histogram counts
+    over the REAL macroblocks psum before the cumsum/argmax, so every
+    band carries the same global median (the next frame's temporal
+    search center)."""
+    cnt, n = hist_counts_banded(mv_flat, mb_mask, lim, axis_name,
+                                num_bands)
     cum = jnp.cumsum(cnt, axis=0)
     return ((cum >= (n + 1) // 2).argmax(axis=0) - lim).astype(jnp.int32)
 
 
+def median_from_counts(cnt, n, lim: int):
+    """Host-side tail of the split median (numpy): the exact mirror of
+    hist_median_banded's cumsum/argmax over the cross-host-summed
+    counts — every farm host derives the SAME (2,) int32 median the
+    full-mesh psum would have carried on device."""
+    import numpy as _np
+
+    cum = _np.cumsum(_np.asarray(cnt, _np.int64), axis=0)
+    return (_np.argmax(cum >= (int(n) + 1) // 2, axis=0)
+            - lim).astype(_np.int32)
+
+
 def me_search_banded(cur_y16, ref_y16, ref_u16, ref_v16, pred_mv_h, qp,
                      *, halo_rows: int, num_bands: int, axis_name,
-                     real_rows):
+                     real_rows, ext=None, edge_top: bool = True,
+                     edge_bot: bool = True, probe=None,
+                     return_hist: bool = False):
     """Full ME+MC for one P frame of ONE BAND (the SFE search).
 
     cur/ref planes are this band's (Hb, W) shard (Hb a multiple of 16);
@@ -877,22 +975,41 @@ def me_search_banded(cur_y16, ref_y16, ref_u16, ref_v16, pred_mv_h, qp,
     band's MB rows back out; per-MB selection is independent, so the
     extended rows' results are simply discarded.
 
+    Farm mode (cross-host band slices): `ext` = (top_y, bot_y, top_u,
+    bot_u, top_v, bot_v) host-injected neighbor reference rows for the
+    slice edges (with `edge_top`/`edge_bot` marking which edges are
+    true frame edges), `probe` = the host-resolved global probe center
+    (banded_probe_cost → cross-host sum → probe_center_from_cost), and
+    `return_hist=True` swaps the on-device median for the per-host
+    histogram partial (cnt, n) so the caller can finish the median
+    across hosts (median_from_counts). With identical injected values
+    the per-MB (mv, pred) results are bit-identical to the full-mesh
+    psum/ppermute program.
+
     Returns (mv (Hb/16, mbw, 2) int32 half-pel, pred_y, pred_u, pred_v
-    int16 band planes, med_mv_h (2,) int32 — the GLOBAL median)."""
+    int16 band planes, med_mv_h (2,) int32 — the GLOBAL median), or
+    with `return_hist` (mv, py, pu, pv, cnt, n)."""
     Hb, W = cur_y16.shape
     if halo_rows <= 0 or halo_rows % 16:
         raise ValueError("halo_rows must be a positive multiple of 16")
     halo = halo_rows
-    ry_ext = band_halo_exchange(ref_y16, halo, axis_name, num_bands)
-    ru_ext = band_halo_exchange(ref_u16, halo // 2, axis_name, num_bands)
-    rv_ext = band_halo_exchange(ref_v16, halo // 2, axis_name, num_bands)
+    ty, by, tu, bu, tv, bv = ext if ext is not None else (None,) * 6
+    ry_ext = band_halo_exchange(ref_y16, halo, axis_name, num_bands,
+                                top_ext=ty, bot_ext=by,
+                                edge_top=edge_top, edge_bot=edge_bot)
+    ru_ext = band_halo_exchange(ref_u16, halo // 2, axis_name, num_bands,
+                                top_ext=tu, bot_ext=bu,
+                                edge_top=edge_top, edge_bot=edge_bot)
+    rv_ext = band_halo_exchange(ref_v16, halo // 2, axis_name, num_bands,
+                                top_ext=tv, bot_ext=bv,
+                                edge_top=edge_top, edge_bot=edge_bot)
     # halo rows of CUR only feed the discarded extension MBs' SADs;
     # edge replication keeps them in range
     cur_ext = jnp.concatenate([
         jnp.broadcast_to(cur_y16[:1], (halo, W)), cur_y16,
         jnp.broadcast_to(cur_y16[Hb - 1:], (halo, W))])
     centers = banded_centers_from(cur_y16, ref_y16, pred_mv_h, real_rows,
-                                  halo, axis_name, num_bands)
+                                  halo, axis_name, num_bands, probe=probe)
     lam = jnp.asarray(LAMBDA_H)[jnp.clip(qp, 0, 51)]
     if use_pallas():
         mv_e, py_e, pu_e, pv_e = me_search_pallas(
@@ -907,6 +1024,11 @@ def me_search_banded(cur_y16, ref_y16, ref_u16, ref_v16, pred_mv_h, qp,
     pu = jax.lax.slice_in_dim(pu_e, halo // 2, (halo + Hb) // 2, axis=0)
     pv = jax.lax.slice_in_dim(pv_e, halo // 2, (halo + Hb) // 2, axis=0)
     mb_mask = jnp.repeat(jnp.arange(mbh_b) * 16 < real_rows, mv.shape[1])
+    if return_hist:
+        cnt, n = hist_counts_banded(mv.reshape(-1, 2), mb_mask,
+                                    2 * SEARCH_RANGE, axis_name,
+                                    num_bands)
+        return mv, py, pu, pv, cnt, n
     med = hist_median_banded(mv.reshape(-1, 2), mb_mask,
                              2 * SEARCH_RANGE, axis_name, num_bands)
     return mv, py, pu, pv, med

@@ -222,6 +222,20 @@ DEFAULT_SETTINGS: dict[str, Any] = {
     # (TVT_REMOTE_HTTP_RETRIES / TVT_REMOTE_HTTP_BACKOFF_S).
     "remote_http_retries": 4,
     "remote_http_backoff_s": 0.5,
+    # farm split-frame encoding (cluster/remote.py band shards +
+    # cluster/halo.py): sfe_farm (TVT_SFE_FARM) lets the remote
+    # backend plan frame-BAND shards (one band slice per worker, halo
+    # exchanged per frame over the /work relay) whenever sfe_bands > 0
+    # — off keeps the remote backend farming whole GOP ranges even
+    # with SFE configured locally; halo_timeout_s (TVT_HALO_TIMEOUT_S)
+    # bounds how long a band worker waits for a peer's halo blob
+    # before failing the shard (the board then restarts the lockstep
+    # group); live_farm_catchup (TVT_LIVE_FARM_CATCHUP) lets a live
+    # job's backlog GOPs fan across the farm while the newest GOP
+    # encodes locally at the edge.
+    "sfe_farm": True,
+    "halo_timeout_s": 60.0,
+    "live_farm_catchup": True,
 }
 
 _ENV_PREFIX = "TVT_"
@@ -349,6 +363,12 @@ _CLAMPS: dict[str, Callable[[Any], Any]] = {
     "remote_http_retries": lambda v: min(20, max(0, as_int(v, 4))),
     "remote_http_backoff_s": lambda v: min(30.0, max(
         0.05, as_float(v, 0.5))),
+    "sfe_farm": lambda v: as_bool(v, True),
+    # floor: sub-second would flap on a single straggling device step;
+    # cap: a dead peer must fail into the lease machinery well inside
+    # a band shard's (per-GOP-scaled) lease budget
+    "halo_timeout_s": lambda v: min(600.0, max(1.0, as_float(v, 60.0))),
+    "live_farm_catchup": lambda v: as_bool(v, True),
     "farm_min_workers": lambda v: min(4096, max(0, as_int(v, 0))),
     "farm_max_workers": lambda v: min(4096, max(0, as_int(v, 0))),
     # floor keeps a drain from force-requeueing leases the instant it

@@ -375,6 +375,14 @@ SHARD_CLAIM_SECONDS = REGISTRY.histogram(
     buckets=(0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
              300.0, 600.0))
 
+# -- farm SFE halo relay (cluster/halo.py) -----------------------------
+HALO_RELAY_BLOBS = REGISTRY.gauge(
+    "tvt_halo_relay_blobs",
+    "band-shard halo blobs buffered on the coordinator relay")
+HALO_RELAY_BYTES = REGISTRY.gauge(
+    "tvt_halo_relay_bytes",
+    "bytes of band-shard halo blobs buffered on the coordinator relay")
+
 # -- durable part spool + crash resume (cluster/partstore.py) -----------
 PART_SPOOL_BYTES = REGISTRY.gauge(
     "tvt_part_spool_bytes",

@@ -112,7 +112,7 @@ def default_mesh(devices=None) -> Mesh:
 #: single-stream glass-to-bitstream latency (SfeShardEncoder))
 STAGE_NAMES = ("decode", "stage", "scale", "dispatch", "device_wait",
                "fetch", "dense_retry", "sparse_unpack", "unflatten",
-               "pack", "concat", "sfe")
+               "pack", "concat", "sfe", "halo")
 
 #: monotonic counters riding in the same snapshot as the stage clocks:
 #: dense_fallback_waves (waves that overflowed the sparse budgets and
@@ -1400,6 +1400,129 @@ def _sfe_p_step_dense(y, u, v, ry, ru, rv, pmv, qp, real_rows, *,
     return shard(y, u, v, ry, ru, rv, pmv, qp, real_rows)
 
 
+# ---------------------------------------------------------------------------
+# farm-split SFE steps (cross-HOST band slices, parallel/sfefarm.py)
+#
+# The local steps above run the halo exchange and the probe/median
+# psums inside ONE program over the full band mesh. When the band
+# layout spans HOSTS, the cross-host halves of those collectives move
+# to the host side: neighbor reference rows arrive as injected inputs
+# (cluster/halo.py carries them between hosts per frame), the probe
+# splits into a per-host partial-cost program + a host-side argmin,
+# and the median histogram leaves the device as a per-host partial.
+# All three are integer sums, so host-side reduction is bit-identical
+# to the device psum — the farm stream equals the local-mesh stream.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "num_bands"))
+def _sfe_probe_step(cur_y, ref_y, real_rows, top_y, bot_y, edges, *,
+                    mesh: Mesh | None, num_bands: int):
+    """Per-host half of the split global-motion probe: each local
+    band's partial per-window SAD cost, psum'd over THIS mesh only.
+    Returns (num_bands, n*n) int32 — every row identical; the host
+    ships row 0 to its peers and argmins the cross-host sum
+    (jaxme.probe_center_from_cost). `edges` is the traced (2,) bool
+    [edge_top, edge_bot] — an INPUT, not a static, so one compiled
+    program serves a band slice at any position in the layout."""
+    from ..codecs.h264 import jaxme
+
+    def per_band(cur_b, ref_b, real_b, ty_b, by_b, edges_):
+        cost = jaxme.banded_probe_cost(
+            cur_b.astype(jnp.int16), ref_b, real_b[0, 0],
+            "band" if mesh is not None else None, num_bands,
+            top_ext=ty_b, bot_ext=by_b,
+            edge_top=edges_[0], edge_bot=edges_[1])
+        return cost[None]
+
+    if mesh is None:
+        return per_band(cur_y, ref_y, real_rows, top_y, bot_y, edges)
+    shard = shard_map(per_band, mesh=mesh,
+                      in_specs=(P("band"),) * 5 + (P(),),
+                      out_specs=P("band"))
+    return shard(cur_y, ref_y, real_rows, top_y, bot_y, edges)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "mbw", "mbh_band", "mesh", "halo_rows", "num_bands"))
+def _sfe_p_step_farm(y, u, v, ry, ru, rv, pred_mv, probe, ty, by, tu,
+                     bu, tv, bv, qp, real_rows, edges, *, mbw: int,
+                     mbh_band: int, mesh: Mesh | None, halo_rows: int,
+                     num_bands: int):
+    """One P frame of a band SLICE: the search runs on halo-extended
+    planes whose slice-edge rows were injected by the host (`ty..bv`,
+    band-sharded — only the edge bands' shards are read), the probe
+    center and temporal median arrive as replicated host inputs, and
+    the per-host histogram partial rides out beside the compact level
+    streams. `mesh=None` = single local band, as in the local steps.
+    `edges` = traced (2,) bool [edge_top, edge_bot] (an input, not a
+    static: a worker re-claiming a DIFFERENT band slice reuses the
+    same compiled program)."""
+    from ..codecs.h264 import jaxinter
+
+    def per_band(y_b, u_b, v_b, ry_b, ru_b, rv_b, pred_, probe_, ty_b,
+                 by_b, tu_b, bu_b, tv_b, bv_b, qp_, real_b, edges_):
+        mv8, flat, cnt, n, (ry2, ru2, rv2, _pm) = jaxinter.sfe_p_band(
+            y_b, u_b, v_b, (ry_b, ru_b, rv_b, pred_), qp_, real_b[0, 0],
+            mbw=mbw, mbh_band=mbh_band, halo_rows=halo_rows,
+            num_bands=num_bands,
+            axis_name="band" if mesh is not None else None,
+            ext=(ty_b, by_b, tu_b, bu_b, tv_b, bv_b),
+            edge_top=edges_[0], edge_bot=edges_[1], probe=probe_,
+            return_hist=True)
+        nblk, nval, n_esc, used, payload = _sfe_pack_band(flat)
+        return (mv8[None], nblk[None], nval[None], n_esc[None],
+                used[None], payload[None], cnt[None],
+                n.reshape(1), ry2, ru2, rv2)
+
+    if mesh is None:
+        return per_band(y, u, v, ry, ru, rv, pred_mv, probe, ty, by,
+                        tu, bu, tv, bv, qp, real_rows, edges)
+    shard = shard_map(
+        per_band, mesh=mesh,
+        in_specs=(P("band"),) * 6 + (P(), P()) + (P("band"),) * 6
+        + (P(), P("band"), P()),
+        out_specs=(P("band"),) * 11)
+    return shard(y, u, v, ry, ru, rv, pred_mv, probe, ty, by, tu, bu,
+                 tv, bv, qp, real_rows, edges)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "mbw", "mbh_band", "mesh", "halo_rows", "num_bands"))
+def _sfe_p_step_farm_dense(y, u, v, ry, ru, rv, pred_mv, probe, ty, by,
+                           tu, bu, tv, bv, qp, real_rows, edges, *,
+                           mbw: int, mbh_band: int, mesh: Mesh | None,
+                           halo_rows: int, num_bands: int):
+    """Escape fallback for the farm P step: same compute, uncompressed
+    int16 levels. The replay is host-local (the cached per-frame
+    injected inputs fully determine this slice's bits), so no
+    histogram needs to leave the device."""
+    from ..codecs.h264 import jaxinter
+
+    def per_band(y_b, u_b, v_b, ry_b, ru_b, rv_b, pred_, probe_, ty_b,
+                 by_b, tu_b, bu_b, tv_b, bv_b, qp_, real_b, edges_):
+        mv8, flat, _cnt, _n, (ry2, ru2, rv2, _pm) = jaxinter.sfe_p_band(
+            y_b, u_b, v_b, (ry_b, ru_b, rv_b, pred_), qp_, real_b[0, 0],
+            mbw=mbw, mbh_band=mbh_band, halo_rows=halo_rows,
+            num_bands=num_bands,
+            axis_name="band" if mesh is not None else None,
+            ext=(ty_b, by_b, tu_b, bu_b, tv_b, bv_b),
+            edge_top=edges_[0], edge_bot=edges_[1], probe=probe_,
+            return_hist=True)
+        return mv8[None], flat[None], ry2, ru2, rv2
+
+    if mesh is None:
+        return per_band(y, u, v, ry, ru, rv, pred_mv, probe, ty, by,
+                        tu, bu, tv, bv, qp, real_rows, edges)
+    shard = shard_map(
+        per_band, mesh=mesh,
+        in_specs=(P("band"),) * 6 + (P(), P()) + (P("band"),) * 6
+        + (P(), P("band"), P()),
+        out_specs=(P("band"),) * 5)
+    return shard(y, u, v, ry, ru, rv, pred_mv, probe, ty, by, tu, bu,
+                 tv, bv, qp, real_rows, edges)
+
+
 class SfeShardEncoder(GopShardEncoder):
     """Split-frame encoding: ONE frame sharded across the mesh as
     horizontal MB-row bands, each entropy-coded as its own H.264 slice.
@@ -1429,17 +1552,48 @@ class SfeShardEncoder(GopShardEncoder):
                  halo_rows: int | None = None,
                  pack_workers: int | None = None,
                  pipeline_window: int | None = None,
-                 decode_ahead: int | None = None):
+                 decode_ahead: int | None = None,
+                 total_bands: int = 0,
+                 band_range: tuple[int, int] | None = None):
         snap = get_settings()
         full_mesh = mesh if mesh is not None else default_mesh()
         devices = list(full_mesh.devices.flat)
-        want = int(bands) or len(devices)
         mbh = (meta.height + 15) // 16
         mbw = (meta.width + 15) // 16
-        #: pinned per-job band layout (MB-row aligned; the last band may
-        #: carry padding rows that are computed but never entropy-coded)
-        self.band_plan: BandPlan = plan_bands(
-            mbh, mbw, max(1, min(want, len(devices))))
+        #: pinned GLOBAL band layout. Locally `total_bands=0` sizes it
+        #: to this process's devices; on a farm the coordinator pins
+        #: `total_bands` for the whole frame and `band_range=(lo, hi)`
+        #: assigns this process a contiguous slice of it (the cross-
+        #: host SFE shard, parallel/sfefarm.py) — the layout (and so
+        #: the slice structure of the bitstream) never depends on any
+        #: one host's device count.
+        if total_bands:
+            self.global_band_plan: BandPlan = plan_bands(
+                mbh, mbw, max(1, int(total_bands)))
+        else:
+            want = int(bands) or len(devices)
+            self.global_band_plan = plan_bands(
+                mbh, mbw, max(1, min(want, len(devices))))
+        lo, hi = band_range if band_range is not None \
+            else (0, self.global_band_plan.num_bands)
+        lo, hi = int(lo), min(int(hi), self.global_band_plan.num_bands)
+        if not 0 <= lo < hi:
+            raise ValueError(f"empty band range [{lo}, {hi})")
+        if hi - lo > len(devices):
+            raise ValueError(
+                f"band slice [{lo}, {hi}) needs {hi - lo} devices; "
+                f"this host has {len(devices)}")
+        #: this process's slice of the layout (band indices, and hence
+        #: slice first_mb coordinates, stay GLOBAL)
+        self.band_lo, self.band_hi = lo, hi
+        self.band_plan: BandPlan = BandPlan(
+            bands=self.global_band_plan.bands[lo:hi],
+            band_mb_rows=self.global_band_plan.band_mb_rows,
+            mb_width=self.global_band_plan.mb_width)
+        #: frame 0 of each GOP opens the picture's access unit with
+        #: SPS/PPS — only the band slice that owns band 0 emits them
+        #: (a farm peer's slices join the SAME access unit downstream)
+        self.emit_parameter_sets = lo == 0
         band_mesh = Mesh(np.array(devices[:self.band_plan.num_bands]),
                          ("band",))
         super().__init__(meta, qp=qp, mesh=band_mesh,
@@ -1514,11 +1668,16 @@ class SfeShardEncoder(GopShardEncoder):
     def stage_waves(self, frames):
         """One GOP per staged wave: each frame device_put row-sharded
         over the band mesh (padded to the band grid's height with edge
-        replication — the padding rows are computed and discarded)."""
+        replication — the padding rows are computed and discarded). A
+        band SLICE (farm mode) pads to the GLOBAL grid height and
+        uploads only its own rows — each host decodes the full frame
+        but stages O(slice) pixels."""
         plan = self.plan(len(frames))
         cursor = _FrameCursor(frames, self.stages, require_420=True,
                               stats=self.staging_stats)
-        Hg = self.band_plan.padded_mb_height * 16
+        rows16 = self.band_plan.band_mb_rows * 16
+        Hg = self.global_band_plan.padded_mb_height * 16
+        y0, y1 = self.band_lo * rows16, self.band_hi * rows16
         shard = NamedSharding(self.mesh, P("band"))
         for gop in plan.gops:
             cursor.get(gop.end_frame - 1)   # decode outside "stage"
@@ -1526,9 +1685,9 @@ class SfeShardEncoder(GopShardEncoder):
                 ys, us, vs = [], [], []
                 for i in range(gop.start_frame, gop.end_frame):
                     f = cursor.get(i)
-                    ya = self._pad_rows(f.y, Hg)
-                    ua = self._pad_rows(f.u, Hg // 2)
-                    va = self._pad_rows(f.v, Hg // 2)
+                    ya = self._pad_rows(f.y, Hg)[y0:y1]
+                    ua = self._pad_rows(f.u, Hg // 2)[y0 // 2:y1 // 2]
+                    va = self._pad_rows(f.v, Hg // 2)[y0 // 2:y1 // 2]
                     self.stages.bump("h2d_bytes", ya.nbytes + ua.nbytes
                                      + va.nbytes)
                     ys.append(jax.device_put(ya, shard))
@@ -1740,7 +1899,7 @@ class SfeShardEncoder(GopShardEncoder):
                                 head_h[b], r(), b, qp, fn),
                             rest, bi, fi % 256))
                 frame_nal = b"".join(self._gather_frame(thunks))
-            if fi == 0:
+            if fi == 0 and self.emit_parameter_sets:
                 frame_nal = self.sps.to_nal() + self.pps.to_nal() \
                     + frame_nal
             nals.append(frame_nal)
@@ -1804,7 +1963,7 @@ class SfeShardEncoder(GopShardEncoder):
                                 m[b], f[b], b, qp, fn),
                             bi, head_h, flat_h, fi % 256))
                 frame_nal = b"".join(self._gather_frame(thunks))
-                if fi == 0:
+                if fi == 0 and self.emit_parameter_sets:
                     frame_nal = self.sps.to_nal() + self.pps.to_nal() \
                         + frame_nal
                 nals.append(frame_nal)
@@ -1830,6 +1989,57 @@ class SfeShardEncoder(GopShardEncoder):
         (pipeline_window > 1) append near-, not strictly-, in order."""
         ts = sorted(self.frame_done_t)
         return [(b - a) * 1e3 for a, b in zip(ts, ts[1:])]
+
+
+def make_shard_encoder(meta: VideoMeta, settings, mesh, *,
+                       shape: str | None = None, rungs=None,
+                       qp: int | None = None, total_bands: int = 0,
+                       band_range: tuple[int, int] | None = None,
+                       halo_rows: int | None = None, session=None):
+    """The ONE plan-driven shard-executor seam: every encode path —
+    local executor, remote worker, live pipeline — resolves its
+    encoder here, keyed off the unified plan shape
+    (parallel/planner.EncodePlan) instead of per-call-site if/else
+    ladders.
+
+    shape=None resolves from settings (`sfe_bands > 0` → band shape,
+    else GOP waves); `rungs` selects the ladder form (which stages
+    once and fans renditions); `band_range`/`total_bands` select the
+    cross-host band-slice form (parallel/sfefarm.py) with `session`
+    carrying the halo exchange."""
+    qp = int(settings.qp) if qp is None else int(qp)
+    gop_frames = int(settings.gop_frames)
+    max_segments = int(settings.max_segments)
+    if rungs:
+        from ..abr.ladder import LadderShardEncoder
+
+        return LadderShardEncoder(meta, list(rungs), mesh=mesh,
+                                  gop_frames=gop_frames,
+                                  max_segments=max_segments)
+    if shape is None:
+        shape = "band" if int(settings.get("sfe_bands", 0) or 0) > 0 \
+            else "gop"
+    if shape == "band":
+        if halo_rows is None:
+            halo_rows = int(settings.get("sfe_halo_rows", 32) or 32)
+        if band_range is not None or total_bands:
+            from .sfefarm import FarmBandEncoder
+
+            return FarmBandEncoder(
+                meta, qp=qp, mesh=mesh, gop_frames=gop_frames,
+                max_segments=max_segments, total_bands=total_bands,
+                band_range=band_range, halo_rows=halo_rows,
+                session=session)
+        return SfeShardEncoder(
+            meta, qp=qp, mesh=mesh, gop_frames=gop_frames,
+            max_segments=max_segments,
+            bands=int(settings.get("sfe_bands", 0) or 0),
+            halo_rows=halo_rows)
+    if shape != "gop":
+        raise ValueError(f"unknown shard shape {shape!r}")
+    return GopShardEncoder(meta, qp=qp, mesh=mesh,
+                           gop_frames=gop_frames,
+                           max_segments=max_segments)
 
 
 def encode_clip_sharded(frames: list[Frame], meta: VideoMeta, qp: int = 27,
