@@ -240,6 +240,59 @@ def _run_sfe(w: int, h: int, nframes: int, qp: int, gop_frames: int,
     }
 
 
+def _run_rd(w: int, h: int, nframes: int, qp: int, gop_frames: int
+            ) -> dict:
+    """Rate-distortion point, features ON vs OFF on the same clip.
+
+    One closed GOP per config through the production GOP program
+    (encode_gop + emit_recon): bits/frame, PSNR-Y, SSIM-Y and the
+    VMAF-proxy figure, measured on the reconstruction — which the
+    conformance suite pins byte-identical to an independent decode of
+    the emitted stream (including deblocked and skip-bearing streams),
+    so the quality numbers are the decoder's, whether or not the
+    libavcodec oracle is present. "on" = the full RD feature set
+    (mode_decision + pskip + deblock + aq_strength 1.0); "off" = the
+    historical encoder. This is the ROADMAP r4-gate measurement: the
+    ON point must reach <= 300 kbit/frame at PSNR-Y >= 36.5 dB at
+    1080p."""
+    from thinvids_tpu.codecs.h264.encoder import encode_gop
+    from thinvids_tpu.codecs.h264.rdo import RdConfig, aq_from_strength
+    from thinvids_tpu.core.types import VideoMeta
+    from thinvids_tpu.tools.metrics import psnr, ssim, vmaf_proxy
+
+    frames = make_frames(nframes, w, h)
+    meta = VideoMeta(width=w, height=h, fps_num=30, fps_den=1,
+                     num_frames=nframes)
+    configs = {
+        "off": RdConfig(),
+        "on": RdConfig(mode_decision=True, pskip=True, deblock=True,
+                       aq_q=aq_from_strength(1.0)),
+    }
+    out: dict = {"qp": qp, "gop_frames": gop_frames, "frames": nframes}
+    for name, rd in configs.items():
+        total_bits = 0
+        ps, ss = [], []
+        for g0 in range(0, nframes, gop_frames):
+            chunk = frames[g0:g0 + gop_frames]
+            stream, recons = encode_gop(
+                chunk, meta, qp=qp, idr_pic_id=g0 // gop_frames,
+                with_headers=(g0 == 0), return_recon=True, rd=rd)
+            total_bits += len(stream) * 8
+            ry = np.asarray(recons[0])
+            for i, f in enumerate(chunk):
+                ps.append(psnr(f.y, ry[i][:h, :w]))
+                ss.append(ssim(f.y, ry[i][:h, :w]))
+        p = float(np.mean([x for x in ps if np.isfinite(x)] or [99.0]))
+        s = float(np.mean(ss))
+        out[name] = {
+            "bits_per_frame": round(total_bits / nframes),
+            "psnr_y": round(p, 2),
+            "ssim_y": round(s, 4),
+            "vmaf_proxy": vmaf_proxy(p, s),
+        }
+    return out
+
+
 def _run_sfe_farm(w: int, h: int, nframes: int, qp: int, gop_frames: int,
                   worker_counts: tuple[int, ...] = (1, 2, 4),
                   job_budget_s: float = 900.0) -> dict:
@@ -1247,7 +1300,8 @@ def build_result(r1080: dict, r4k: dict, *, platform: str, qp: int,
                  live_sfe: dict | None = None,
                  trace: dict | None = None,
                  autoscale: dict | None = None,
-                 crash: dict | None = None) -> dict:
+                 crash: dict | None = None,
+                 rd: dict | None = None) -> dict:
     """Assemble the one-line BENCH JSON from the two resolutions' runs
     (kept separate from main() so tests can assert the schema — e.g.
     the `stage_ms` breakdown and the `fps_cold_1080p` cold figure — on
@@ -1356,6 +1410,22 @@ def build_result(r1080: dict, r4k: dict, *, platform: str, qp: int,
         out["autoscale_jobs_done"] = autoscale["jobs_done"]
         out["chaos_worker_kills"] = autoscale["kills"]
         out["chaos_partitions"] = autoscale["partitions"]
+    if rd is not None:
+        # rate-distortion gate (ROADMAP r4): bits/frame + PSNR-Y +
+        # VMAF-proxy with the RD feature set ON vs OFF on the same
+        # 1080p clip (one RD data point per config, recon == decode by
+        # conformance). vmaf_1080p is the serving-quality headline:
+        # the ON config's proxy score.
+        out["rd_qp"] = rd["qp"]
+        out["rd_gop_frames"] = rd["gop_frames"]
+        out["rd_bits_per_frame"] = rd["on"]["bits_per_frame"]
+        out["rd_psnr_y"] = rd["on"]["psnr_y"]
+        out["rd_ssim_y"] = rd["on"]["ssim_y"]
+        out["rd_bits_per_frame_off"] = rd["off"]["bits_per_frame"]
+        out["rd_psnr_y_off"] = rd["off"]["psnr_y"]
+        out["rd_ssim_y_off"] = rd["off"]["ssim_y"]
+        out["vmaf_1080p"] = rd["on"]["vmaf_proxy"]
+        out["vmaf_1080p_off"] = rd["off"]["vmaf_proxy"]
     if crash is not None:
         # durable shard checkpointing under coordinator SIGKILL + data
         # corruption: shards rehydrated from the verified spool (work
@@ -1419,6 +1489,13 @@ def main() -> None:
     # injected corruption was rejected before stitch.
     r_crash = _run_crash_resume(64, 48, 24, qp, 2)
 
+    # Rate-distortion gate (ROADMAP r4): the RD feature set on vs off
+    # at the serving operating point (qp 25, production gop_frames 32;
+    # the throughput figures above keep the historical qp 27 / gop 8
+    # for cross-round comparability). The ON point must land at
+    # <= 300k bits/frame with PSNR-Y >= 36.5 simultaneously.
+    r_rd = _run_rd(1920, 1080, 32, 25, 32)
+
     # 4K rides with quality ON (psnr_y_2160p/ssim_y_2160p): 16 frames
     # keeps the untimed oracle decode affordable.
     n_4k = 16
@@ -1448,7 +1525,7 @@ def main() -> None:
                                   live_sfe=r_live_sfe,
                                   trace=r_trace,
                                   autoscale=r_autoscale,
-                                  crash=r_crash)))
+                                  crash=r_crash, rd=r_rd)))
 
 
 if __name__ == "__main__":

@@ -305,3 +305,44 @@ def test_run_ladder_reports_aggregate_and_shared_upload():
     single.prepare_waves(bench.make_frames(4, 64, 48))
     assert r["h2d_bytes"] == \
         single.stages.snapshot()["h2d_bytes"] > 0
+
+
+def test_rd_figures_in_schema():
+    """The r4-gate RD point: bits/frame + PSNR-Y + VMAF-proxy with the
+    feature set on vs off ride the BENCH line as first-class keys."""
+    from thinvids_tpu.parallel.dispatch import STAGE_NAMES
+
+    r = {"fps": 30.0, "device_fps": 40.0, "bytes": 1000,
+         "stage_ms": {k: 1.0 for k in STAGE_NAMES} | {"waves": 1},
+         "quality": {}}
+    r4k = {"fps": 2.0, "device_fps": 4.0, "bytes": 2000,
+           "stage_ms": {}, "quality": {}}
+    rd = {"qp": 25, "gop_frames": 32, "frames": 32,
+          "on": {"bits_per_frame": 184369, "psnr_y": 37.54,
+                 "ssim_y": 0.9146, "vmaf_proxy": 74.87},
+          "off": {"bits_per_frame": 205303, "psnr_y": 37.77,
+                  "ssim_y": 0.9202, "vmaf_proxy": 76.25}}
+    out = bench.build_result(r, r4k, platform="cpu", qp=27, gop=8,
+                             n_1080=64, rd=rd)
+    assert out["rd_bits_per_frame"] == 184369
+    assert out["rd_psnr_y"] == 37.54
+    assert out["rd_bits_per_frame_off"] == 205303
+    assert out["rd_psnr_y_off"] == 37.77
+    assert out["vmaf_1080p"] == 74.87
+    assert out["vmaf_1080p_off"] == 76.25
+    assert out["rd_qp"] == 25 and out["rd_gop_frames"] == 32
+    # the r4 gate the ON point must satisfy at 1080p
+    assert out["rd_bits_per_frame"] <= 300_000
+    assert out["rd_psnr_y"] >= 36.5
+
+
+def test_run_rd_small():
+    """_run_rd end-to-end on a tiny clip: both configs report the full
+    metric set and the feature set changes the stream."""
+    r = bench._run_rd(96, 80, nframes=2, qp=27, gop_frames=2)
+    for cfg in ("on", "off"):
+        for k in ("bits_per_frame", "psnr_y", "ssim_y", "vmaf_proxy"):
+            assert k in r[cfg], (cfg, k)
+        assert r[cfg]["bits_per_frame"] > 0
+        assert 0 <= r[cfg]["vmaf_proxy"] <= 100
+    assert r["on"]["bits_per_frame"] != r["off"]["bits_per_frame"]

@@ -71,3 +71,34 @@ class TestEncoderQuality:
         q_hi = clip_quality(frames,
                             [d[0] for d in oracle.decode_h264(stream_hi)])
         assert q_hi["psnr_y"] > q["psnr_y"]
+
+
+class TestVmafProxy:
+    def test_monotone_and_bounded(self):
+        from thinvids_tpu.tools.metrics import vmaf_proxy
+
+        lo = vmaf_proxy(30.0, 0.80)
+        mid = vmaf_proxy(36.0, 0.90)
+        hi = vmaf_proxy(44.0, 0.99)
+        assert 0 <= lo < mid < hi <= 100
+        assert vmaf_proxy(float("inf"), 1.0) == 100.0
+        # monotone in each input separately
+        assert vmaf_proxy(37.0, 0.9) > vmaf_proxy(36.0, 0.9)
+        assert vmaf_proxy(36.0, 0.95) > vmaf_proxy(36.0, 0.9)
+
+    def test_clip_quality_carries_proxy(self):
+        import numpy as np
+
+        from thinvids_tpu.core.types import Frame
+        from thinvids_tpu.tools.metrics import clip_quality, vmaf_proxy
+
+        rng = np.random.default_rng(0)
+        y = rng.integers(0, 256, (32, 48), np.uint8)
+        u = y[::2, ::2].copy()
+        f = Frame(y, u, u)
+        noisy = np.clip(y.astype(np.int16)
+                        + rng.integers(-8, 9, y.shape), 0, 255
+                        ).astype(np.uint8)
+        q = clip_quality([f], [noisy])
+        assert q["vmaf_proxy"] == vmaf_proxy(q["psnr_y"], q["ssim_y"])
+        assert 0 <= q["vmaf_proxy"] <= 100

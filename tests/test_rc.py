@@ -122,3 +122,36 @@ class TestVbr2Pass:
         assert all(q == rc.QP_MIN for q in stats["gop_qps"])
         assert stats["passes"] <= 4
         assert stats["pass2_bits"] < stats["target_bits"]
+
+
+class TestJndMaskedShares:
+    def test_zero_strength_is_identity(self):
+        import numpy as np
+
+        from thinvids_tpu.parallel.rc import jnd_masked_shares
+
+        s = np.asarray([0.5, 0.3, 0.2])
+        np.testing.assert_array_equal(jnd_masked_shares(s, 0.0), s)
+
+    def test_masking_flattens_toward_uniform(self):
+        """Busy GOPs mask their own distortion: their share of the bit
+        budget shrinks relative to raw complexity, flat GOPs gain —
+        but the ORDER is preserved and the result stays a
+        distribution."""
+        import numpy as np
+
+        from thinvids_tpu.parallel.rc import jnd_masked_shares
+
+        s = np.asarray([0.7, 0.2, 0.1])
+        m = jnd_masked_shares(s, 1.0)
+        assert abs(m.sum() - 1.0) < 1e-12
+        assert m[0] < s[0] and m[2] > s[2]
+        assert m[0] > m[1] > m[2]
+
+    def test_vbr2pass_accepts_aq_strength(self):
+        import inspect
+
+        from thinvids_tpu.parallel import rc
+
+        assert "aq_strength" in inspect.signature(
+            rc.encode_vbr2pass).parameters

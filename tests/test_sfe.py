@@ -521,3 +521,107 @@ class TestExecutorWiring:
         media = read_mp4(st.output_path)
         dec = decode_annexb(media.annexb)
         assert len(dec.frames) == n
+
+
+class TestSfeRdFeatures:
+    """Split-frame encoding with the RD features on: band slices must
+    stay conformant (recon == independent decode) for every band
+    count, the in-loop filter must cross band boundaries exactly like
+    the unbanded program (the halo exchange), and the per-band mode
+    decision must stay SLICE-local."""
+
+    RD_ON = None     # set lazily (rdo import inside jax-ready process)
+
+    @classmethod
+    def _rd_on(cls):
+        from thinvids_tpu.codecs.h264.rdo import RdConfig
+
+        return RdConfig(mode_decision=True, pskip=True, deblock=True)
+
+    @multi_device
+    def test_bands_decode_parity_features_on(self):
+        # 7 MB rows across 3 uneven bands: the last band carries
+        # padding rows, so one case covers bands > 1 conformance AND
+        # the deblock row masks stopping at the picture's real rows
+        w, h, n = 96, 112, 4
+        meta = VideoMeta(width=w, height=h, num_frames=n)
+        enc, stream = encode_sfe(clip(w, h, n), meta, bands=3,
+                                 rd=self._rd_on())
+        assert_decode_parity(enc, stream, n)
+
+    @multi_device
+    @pytest.mark.slow
+    def test_single_band_features_match_gop_encoder(self):
+        """bands=1 with features on stays byte-identical to the
+        single-device GOP encode with the same RdConfig."""
+        w, h, n = 64, 128, 3
+        frames = clip(w, h, n)
+        meta = VideoMeta(width=w, height=h, num_frames=n)
+        _, stream = encode_sfe(frames, meta, gop_frames=3, bands=1,
+                               rd=self._rd_on())
+        want = encode_gop(frames, meta, qp=27, idr_pic_id=0,
+                          rd=self._rd_on())
+        assert stream == want
+
+    @multi_device
+    def test_band_mode_decision_is_slice_local(self):
+        """Regression (slice-relative row 0): every band slice's FIRST
+        MB row must never pick vertical prediction — the MBs above
+        live in another slice and are unavailable to a conformant
+        decoder. Checked at the device output, for the mode-decision
+        path and the fixed fallback policy alike."""
+        import jax.numpy as jnp
+
+        from thinvids_tpu.codecs.h264 import jaxinter
+        from thinvids_tpu.codecs.h264.intra import LUMA_V
+        from thinvids_tpu.codecs.h264.rdo import RD_OFF, RdConfig
+
+        w, h = 96, 64
+        f = clip(w, h, 1)[0].padded(16)
+        mbw, band_rows = w // 16, 2        # a 2-MB-row band slice
+        for rd in (RD_OFF, RdConfig(mode_decision=True)):
+            out = jaxinter._intra_core(
+                jnp.asarray(f.y[:16 * band_rows]),
+                jnp.asarray(f.u[:8 * band_rows]),
+                jnp.asarray(f.v[:8 * band_rows]),
+                jnp.asarray(27), mbw=mbw, mbh=band_rows, rd=rd)
+            modes = np.asarray(out[7]).reshape(band_rows, mbw)
+            assert (modes[0] != LUMA_V).all(), rd
+
+    @multi_device
+    def test_sfe_strips_aq(self):
+        """Perceptual AQ is frame-global (the activity mean); the
+        banded encoder must strip it instead of encoding a map that
+        depends on the band count."""
+        from thinvids_tpu.codecs.h264.rdo import RdConfig
+
+        meta = VideoMeta(width=64, height=96, num_frames=2)
+        enc = SfeShardEncoder(meta, qp=27, bands=2,
+                              rd=RdConfig(aq_q=4, pskip=True))
+        assert enc.rd.aq_q == 0 and enc.rd.pskip
+
+    def test_farm_band_slice_rejects_deblock(self):
+        """A cross-host band SLICE cannot run the deblock halo
+        collective; construction must refuse (the remote planner falls
+        back to GOP shards for deblock jobs)."""
+        from thinvids_tpu.codecs.h264.rdo import RdConfig
+
+        meta = VideoMeta(width=64, height=192, num_frames=2)
+        with pytest.raises(ValueError, match="deblock"):
+            SfeShardEncoder(meta, qp=27, total_bands=3,
+                            band_range=(0, 1),
+                            rd=RdConfig(deblock=True))
+
+    def test_remote_planner_gate(self):
+        """deblock-enabled jobs keep GOP-range shards on the farm."""
+        from thinvids_tpu.cluster.remote import RemoteExecutor
+        from thinvids_tpu.core.config import DEFAULT_SETTINGS, Settings
+
+        class _Job:
+            job_type = "transcode"
+
+        on = Settings(values=dict(DEFAULT_SETTINGS, sfe_bands=4,
+                                  deblock=True))
+        off = Settings(values=dict(DEFAULT_SETTINGS, sfe_bands=4))
+        assert RemoteExecutor._band_shape(_Job(), off)
+        assert not RemoteExecutor._band_shape(_Job(), on)

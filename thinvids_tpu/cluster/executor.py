@@ -577,7 +577,8 @@ class LocalExecutor:
             frames, meta, target_kbps, base_qp=int(settings.qp), enc=enc,
             encode_fn=lambda e: self._encode_with_retry(
                 job, token, e, frames, settings, allow_replan=False),
-            on_pass=on_pass)
+            on_pass=on_pass,
+            aq_strength=float(settings.get("aq_strength", 0.0) or 0.0))
         return segments
 
     def _encode_with_retry(self, job: Job, token: str, enc, frames,

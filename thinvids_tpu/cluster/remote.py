@@ -1338,9 +1338,16 @@ class RemoteExecutor(LocalExecutor):
         `sfe_bands > 0` opts the job into split-frame encoding and
         `sfe_farm` (default on) lets the remote backend spread the
         bands across hosts; ladder/live jobs keep their existing shard
-        shapes (rung x range / local edge)."""
+        shapes (rung x range / local edge). A deblock-enabled job
+        keeps GOP-range shards: the in-loop filter's cross-band halo
+        is a device collective, which a cross-host band slice cannot
+        run (the SFE steps refuse it), while whole GOPs deblock
+        entirely worker-locally."""
+        from ..core.config import as_bool
+
         return (int(settings.get("sfe_bands", 0) or 0) > 0
                 and bool(settings.get("sfe_farm", True))
+                and not as_bool(settings.get("deblock", False), False)
                 and getattr(job, "job_type", "transcode") == "transcode")
 
     def _build_band_shards(self, job: Job, meta, num_frames: int,

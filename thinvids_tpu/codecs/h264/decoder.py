@@ -73,6 +73,14 @@ class _Picture:
         self.chroma_counts = np.zeros((2, 2 * mbh, 2 * mbw), np.int32)
         self.mv = np.zeros((mbh, mbw, 2), np.int32)     # (dy, dx) half-pel
         self.decoded = 0                                # MBs decoded so far
+        # in-loop deblocking state: the effective QP_Y of every MB (the
+        # running slice QP after mb_qp_delta; uncoded MBs keep the
+        # running value — §8.7's QP for skipped MBs), the picture's
+        # coding type, and whether ANY slice enabled the filter (all
+        # slices of a picture carry the same idc in our streams).
+        self.qp_mb = np.zeros((mbh, mbw), np.int32)
+        self.intra = True
+        self.deblock = False
 
 
 def _tap6(x: np.ndarray, axis: int) -> np.ndarray:
@@ -194,6 +202,7 @@ def _decode_islice(br: BitReader, pic: _Picture,
         cbp_luma = 15 if (mb_type - 1) >= 12 else 0
         chroma_mode = br.ue()
         qp += br.se()                       # mb_qp_delta
+        pic.qp_mb[my, mx] = qp
         qpc = chroma_qp(qp)
 
         # in-slice neighbor availability (§7.4.3): an MB in another
@@ -300,6 +309,7 @@ def _decode_pslice(br: BitReader, pic: _Picture, header: SliceHeader,
             my, mx = divmod(mi, mbw)
             _, skip_mv = _mvp_and_skip(pic, my, mx, first)
             pic.mv[my, mx] = skip_mv
+            pic.qp_mb[my, mx] = qp          # skip: running QP (§8.7)
             _recon_p_mb(pic, ref, my, mx, skip_mv, zero16, zero_cdc,
                         zero_cac, qp)
             pic.decoded += 1
@@ -322,6 +332,7 @@ def _decode_pslice(br: BitReader, pic: _Picture, header: SliceHeader,
         cbp_luma, cbp_chroma = cbp & 15, cbp >> 4
         if cbp:
             qp += br.se()                      # mb_qp_delta
+        pic.qp_mb[my, mx] = qp
 
         a_ok = mx > 0 and mi - 1 >= first
         b_ok = my > 0 and mi - mbw >= first
@@ -383,6 +394,19 @@ def decode_annexb(stream: bytes) -> DecodedStream:
             raise ValueError(
                 f"picture ended with {pic.decoded} of "
                 f"{pic.mbw * pic.mbh} MBs decoded (missing slice?)")
+        if pic.deblock:
+            # §8.7 in-loop filter over the whole decoded picture
+            # (shifted-plane schedule, codecs/h264/deblock.py): the
+            # filtered planes are both the output frame and the next
+            # P picture's reference — exactly the encoder's recon
+            # carry. Intra prediction inside the picture already ran
+            # on unfiltered samples, as the spec requires.
+            from .deblock import deblock_frame
+
+            nz4 = None if pic.intra else (pic.luma_counts > 0)
+            pic.y, pic.u, pic.v = deblock_frame(
+                pic.y, pic.u, pic.v, pic.qp_mb, intra=pic.intra,
+                nz4=nz4, mv=None if pic.intra else pic.mv)
         w, h = sps.width, sps.height
         frames.append(Frame(
             pic.y[:h, :w], pic.u[:h // 2, :w // 2],
@@ -403,14 +427,17 @@ def decode_annexb(stream: bytes) -> DecodedStream:
             if header.slice_type not in (SLICE_TYPE_I, SLICE_TYPE_P):
                 raise ValueError(
                     f"unsupported slice type {header.slice_type}")
-            if not header.disable_deblocking:
+            if header.deblock_idc == 2:
                 raise ValueError(
-                    "deblocking not implemented; stream must disable it")
+                    "disable_deblocking_filter_idc == 2 (slice-local "
+                    "filtering) not supported; this codec emits 0 or 1")
             if header.first_mb == 0:
                 finish_picture()              # new access unit
                 pic = _Picture(sps)
             elif pic is None:
                 raise ValueError("slice with first_mb != 0 opens a picture")
+            pic.intra = header.slice_type == SLICE_TYPE_I
+            pic.deblock = pic.deblock or header.deblock_idc == 0
             if header.slice_type == SLICE_TYPE_I:
                 _decode_islice(br, pic, header)
             else:

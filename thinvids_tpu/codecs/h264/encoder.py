@@ -76,9 +76,17 @@ class FrameLevels:
     luma_ac: np.ndarray      # (nmb, 16, 15), z-scan block order
     chroma_dc: np.ndarray    # (nmb, 2, 4), raster DC order (Cb, Cr)
     chroma_ac: np.ndarray    # (nmb, 2, 4, 15)
+    #: per-MB qp - slice qp (perceptual AQ; None = flat QP, the
+    #: historical layout). Packers emit it as mb_qp_delta.
+    qp_delta: np.ndarray | None = None
 
 
 def _mode_policy(mbw: int, mbh: int) -> tuple[np.ndarray, np.ndarray]:
+    """The FIXED mode raster (rd.mode_decision off): rows >= 1
+    vertical, row 0 horizontal with DC at the slice corner. Row 0 here
+    is SLICE-relative: a split-frame band slice passes its own band
+    `mbh`, so its first MB row gets the H/DC policy exactly where the
+    decoder finds the MBs above unavailable (§7.4.3)."""
     luma = np.full((mbh, mbw), LUMA_V, np.int32)
     luma[0, :] = LUMA_H
     luma[0, 0] = LUMA_DC
@@ -88,78 +96,247 @@ def _mode_policy(mbw: int, mbh: int) -> tuple[np.ndarray, np.ndarray]:
     return luma.reshape(-1), chroma.reshape(-1)
 
 
-def encode_frame_arrays(y: np.ndarray, u: np.ndarray, v: np.ndarray, qp: int
+def _greedy_allowed_np(desired: np.ndarray) -> np.ndarray:
+    """Sequential mirror of jaxcore._greedy_allowed: allowed[c] =
+    desired[c] & !allowed[c-1]."""
+    allowed = np.zeros_like(desired)
+    prev = False
+    for c in range(len(desired)):
+        allowed[c] = bool(desired[c]) and not prev
+        prev = allowed[c]
+    return allowed
+
+
+def _encode_luma_mb_np(src, pred, qp: int):
+    """One MB's luma transform/quant/recon at `qp` → (dc_lev (16,),
+    ac_lev (16, 15), recon (16, 16) uint8)."""
+    resid = src.astype(np.int32) - pred.astype(np.int32)
+    blocks = np.stack([
+        resid[4 * by:4 * by + 4, 4 * bx:4 * bx + 4]
+        for bx, by in LUMA_BLOCK_ORDER
+    ])                                             # (16,4,4) z-scan
+    w = forward_4x4(blocks)
+    dc_spatial = np.zeros((4, 4), np.int32)
+    for bi, (bx, by) in enumerate(LUMA_BLOCK_ORDER):
+        dc_spatial[by, bx] = w[bi, 0, 0]
+    wd = luma_dc_forward(dc_spatial)
+    dc_lev = zigzag(luma_dc_quant(wd, qp))
+    z = quant_4x4(w, qp, intra=True, skip_dc=True)
+    ac_lev = zigzag(z)[:, 1:]
+    return dc_lev, ac_lev, reconstruct_luma16(pred, dc_lev, ac_lev, qp)
+
+
+def _encode_chroma_mb_np(csrc, cpred, qpc: int):
+    """One MB's single-plane chroma encode → (dc_lev (4,), ac_lev
+    (4, 15), recon (8, 8) uint8)."""
+    cres = csrc.astype(np.int32) - cpred.astype(np.int32)
+    cblocks = np.stack([
+        cres[4 * by:4 * by + 4, 4 * bx:4 * bx + 4]
+        for bx, by in CHROMA_BLOCK_ORDER
+    ])                                             # (4,4,4)
+    cw = forward_4x4(cblocks)
+    cdc = np.array([[cw[0, 0, 0], cw[1, 0, 0]],
+                    [cw[2, 0, 0], cw[3, 0, 0]]], np.int32)
+    wd2 = chroma_dc_forward(cdc)
+    dc_lev = chroma_dc_quant(wd2, qpc).reshape(-1)
+    cz = quant_4x4(cw, qpc, intra=True, skip_dc=True)
+    ac_lev = zigzag(cz)[:, 1:]
+    return dc_lev, ac_lev, reconstruct_chroma8(cpred, dc_lev, ac_lev, qpc)
+
+
+def encode_frame_arrays(y: np.ndarray, u: np.ndarray, v: np.ndarray,
+                        qp: int, rd=None
                         ) -> tuple[FrameLevels, tuple[np.ndarray, np.ndarray, np.ndarray]]:
     """Numpy reference of the intra compute path.
 
-    Inputs are padded planes (y: multiple of 16, chroma: half). Returns the
-    level arrays and the reconstructed planes (the decoder's exact output).
+    Inputs are padded planes (y: multiple of 16, chroma: half). Returns
+    the level arrays and the reconstructed planes (the decoder's exact
+    output). `rd` (rdo.RdConfig) enables the per-MB SATD mode decision
+    and/or perceptual AQ; the decision follows jaxcore._intra_core's
+    two-stage row schedule EXACTLY (same candidates, same greedy
+    left-neighbor constraint, same tie-breaks), so the device and
+    reference paths stay bit-identical feature-on as well as off.
     """
+    from . import rdo
+    from .rdo import RD_OFF
+
+    if rd is None:
+        rd = RD_OFF
     mbh, mbw = y.shape[0] // 16, y.shape[1] // 16
     nmb = mbh * mbw
-    qpc = chroma_qp(qp)
+    if rd.aq_q > 0:
+        qp_mb = rdo.clamp_qp_map(
+            qp, rdo.aq_offsets_np(y, rd.aq_q, mbw, mbh))
+    else:
+        qp_mb = np.full(nmb, qp, np.int32)
     luma_mode, chroma_mode = _mode_policy(mbw, mbh)
 
     recon_y = np.zeros_like(y)
     recon_u = np.zeros_like(u)
     recon_v = np.zeros_like(v)
     levels = FrameLevels(
-        luma_mode=luma_mode,
-        chroma_mode=chroma_mode,
+        luma_mode=luma_mode.copy(),
+        chroma_mode=chroma_mode.copy(),
         luma_dc=np.zeros((nmb, 16), np.int32),
         luma_ac=np.zeros((nmb, 16, 15), np.int32),
         chroma_dc=np.zeros((nmb, 2, 4), np.int32),
         chroma_ac=np.zeros((nmb, 2, 4, 15), np.int32),
+        qp_delta=(qp_mb - qp).astype(np.int32) if rd.ships_modes else None,
     )
 
-    for my in range(mbh):
+    def store_mb(mi, my, mx, ymode, cmode, pred_y, pred_u, pred_v):
+        q = int(qp_mb[mi])
+        qc = chroma_qp(q)
+        levels.luma_mode[mi] = ymode
+        levels.chroma_mode[mi] = cmode
+        dc, ac, rec = _encode_luma_mb_np(
+            y[16 * my:16 * my + 16, 16 * mx:16 * mx + 16], pred_y, q)
+        levels.luma_dc[mi] = dc
+        levels.luma_ac[mi] = ac
+        recon_y[16 * my:16 * my + 16, 16 * mx:16 * mx + 16] = rec
+        for ci, (plane, recon, cpred) in enumerate(
+                ((u, recon_u, pred_u), (v, recon_v, pred_v))):
+            cdc, cac, crec = _encode_chroma_mb_np(
+                plane[8 * my:8 * my + 8, 8 * mx:8 * mx + 8], cpred, qc)
+            levels.chroma_dc[mi, ci] = cdc
+            levels.chroma_ac[mi, ci] = cac
+            recon[8 * my:8 * my + 8, 8 * mx:8 * mx + 8] = crec
+
+    # --- row 0: sequential (left-only dependencies) ------------------
+    for mx in range(mbw):
+        mi = mx
+        if mx == 0:
+            store_mb(mi, 0, 0, LUMA_DC, CHROMA_DC,
+                     np.full((16, 16), 128, np.uint8),
+                     np.full((8, 8), 128, np.uint8),
+                     np.full((8, 8), 128, np.uint8))
+            continue
+        left = recon_y[:16, 16 * mx - 1]
+        cleft_u = recon_u[:8, 8 * mx - 1]
+        cleft_v = recon_v[:8, 8 * mx - 1]
+        pred_h = predict_luma16(LUMA_H, None, left, None)
+        pred_hu = predict_chroma8(CHROMA_H, None, cleft_u, None)
+        pred_hv = predict_chroma8(CHROMA_H, None, cleft_v, None)
+        ymode, cmode = LUMA_H, CHROMA_H
+        pred_y, pred_u, pred_v = pred_h, pred_hu, pred_hv
+        if rd.mode_decision:
+            src = y[:16, 16 * mx:16 * mx + 16].astype(np.int32)
+            pred_dc = predict_luma16(LUMA_DC, None, left, None)
+            c_h = rdo.satd16_np(src - pred_h.astype(np.int32))
+            c_dc = rdo.satd16_np(src - pred_dc.astype(np.int32))
+            if c_dc < c_h:
+                ymode, pred_y = LUMA_DC, pred_dc
+            pred_dcu = predict_chroma8(CHROMA_DC, None, cleft_u, None)
+            pred_dcv = predict_chroma8(CHROMA_DC, None, cleft_v, None)
+            su = u[:8, 8 * mx:8 * mx + 8].astype(np.int32)
+            sv = v[:8, 8 * mx:8 * mx + 8].astype(np.int32)
+            cc_h = (rdo.satd8_np(su - pred_hu.astype(np.int32))
+                    + rdo.satd8_np(sv - pred_hv.astype(np.int32)))
+            cc_dc = (rdo.satd8_np(su - pred_dcu.astype(np.int32))
+                     + rdo.satd8_np(sv - pred_dcv.astype(np.int32)))
+            if cc_dc < cc_h:
+                cmode, pred_u, pred_v = CHROMA_DC, pred_dcu, pred_dcv
+        store_mb(mi, 0, mx, ymode, cmode, pred_y, pred_u, pred_v)
+
+    # --- rows >= 1: two-stage (vertical pass, then switched MBs) -----
+    for my in range(1, mbh):
+        top_y = recon_y[16 * my - 1]
+        top_u = recon_u[8 * my - 1]
+        top_v = recon_v[8 * my - 1]
+        preds_v = []
+        for mx in range(mbw):
+            preds_v.append((
+                predict_luma16(LUMA_V, top_y[16 * mx:16 * mx + 16],
+                               None, None),
+                predict_chroma8(CHROMA_V, top_u[8 * mx:8 * mx + 8],
+                                None, None),
+                predict_chroma8(CHROMA_V, top_v[8 * mx:8 * mx + 8],
+                                None, None)))
+        if not rd.mode_decision:
+            for mx in range(mbw):
+                py, pu, pv = preds_v[mx]
+                store_mb(my * mbw + mx, my, mx, LUMA_V, CHROMA_V,
+                         py, pu, pv)
+            continue
+
+        # stage 1: vertical candidate recon for the whole row
+        vrec = []
         for mx in range(mbw):
             mi = my * mbw + mx
-            # --- luma ---
-            src = y[16 * my:16 * my + 16, 16 * mx:16 * mx + 16]
-            top = recon_y[16 * my - 1, 16 * mx:16 * mx + 16] if my > 0 else None
-            left = recon_y[16 * my:16 * my + 16, 16 * mx - 1] if mx > 0 else None
-            tl = int(recon_y[16 * my - 1, 16 * mx - 1]) if (my > 0 and mx > 0) else None
-            pred = predict_luma16(int(luma_mode[mi]), top, left, tl)
-            resid = src.astype(np.int32) - pred.astype(np.int32)
-            blocks = np.stack([
-                resid[4 * by:4 * by + 4, 4 * bx:4 * bx + 4]
-                for bx, by in LUMA_BLOCK_ORDER
-            ])                                             # (16,4,4) z-scan
-            w = forward_4x4(blocks)
-            # DC path: spatial (4,4) grid of per-block DCs, zig-zag coded.
-            dc_spatial = np.zeros((4, 4), np.int32)
-            for bi, (bx, by) in enumerate(LUMA_BLOCK_ORDER):
-                dc_spatial[by, bx] = w[bi, 0, 0]
-            wd = luma_dc_forward(dc_spatial)
-            levels.luma_dc[mi] = zigzag(luma_dc_quant(wd, qp))
-            z = quant_4x4(w, qp, intra=True, skip_dc=True)
-            levels.luma_ac[mi] = zigzag(z)[:, 1:]
-            recon_y[16 * my:16 * my + 16, 16 * mx:16 * mx + 16] = (
-                reconstruct_luma16(pred, levels.luma_dc[mi], levels.luma_ac[mi], qp)
-            )
-            # --- chroma ---
-            for ci, (plane, recon) in enumerate(((u, recon_u), (v, recon_v))):
-                csrc = plane[8 * my:8 * my + 8, 8 * mx:8 * mx + 8]
-                ctop = recon[8 * my - 1, 8 * mx:8 * mx + 8] if my > 0 else None
-                cleft = recon[8 * my:8 * my + 8, 8 * mx - 1] if mx > 0 else None
-                ctl = int(recon[8 * my - 1, 8 * mx - 1]) if (my > 0 and mx > 0) else None
-                cpred = predict_chroma8(int(chroma_mode[mi]), ctop, cleft, ctl)
-                cres = csrc.astype(np.int32) - cpred.astype(np.int32)
-                cblocks = np.stack([
-                    cres[4 * by:4 * by + 4, 4 * bx:4 * bx + 4]
-                    for bx, by in CHROMA_BLOCK_ORDER
-                ])                                         # (4,4,4)
-                cw = forward_4x4(cblocks)
-                cdc = np.array([[cw[0, 0, 0], cw[1, 0, 0]],
-                                [cw[2, 0, 0], cw[3, 0, 0]]], np.int32)
-                wd2 = chroma_dc_forward(cdc)
-                levels.chroma_dc[mi, ci] = chroma_dc_quant(wd2, qpc).reshape(-1)
-                cz = quant_4x4(cw, qpc, intra=True, skip_dc=True)
-                levels.chroma_ac[mi, ci] = zigzag(cz)[:, 1:]
-                recon[8 * my:8 * my + 8, 8 * mx:8 * mx + 8] = reconstruct_chroma8(
-                    cpred, levels.chroma_dc[mi, ci], levels.chroma_ac[mi, ci], qpc
-                )
+            q = int(qp_mb[mi])
+            qc = chroma_qp(q)
+            py, pu, pv = preds_v[mx]
+            _, _, ry = _encode_luma_mb_np(
+                y[16 * my:16 * my + 16, 16 * mx:16 * mx + 16], py, q)
+            _, _, ru = _encode_chroma_mb_np(
+                u[8 * my:8 * my + 8, 8 * mx:8 * mx + 8], pu, qc)
+            _, _, rv = _encode_chroma_mb_np(
+                v[8 * my:8 * my + 8, 8 * mx:8 * mx + 8], pv, qc)
+            vrec.append((ry, ru, rv))
+
+        # stage 2: per-MB candidate costs against the left neighbor's
+        # VERTICAL recon (exact for switched MBs — greedy constraint)
+        INF = 1 << 29
+        desired = np.zeros(mbw, bool)
+        choice = []
+        for mx in range(mbw):
+            mi = my * mbw + mx
+            src = y[16 * my:16 * my + 16, 16 * mx:16 * mx + 16] \
+                .astype(np.int32)
+            su = u[8 * my:8 * my + 8, 8 * mx:8 * mx + 8].astype(np.int32)
+            sv = v[8 * my:8 * my + 8, 8 * mx:8 * mx + 8].astype(np.int32)
+            py, pu, pv = preds_v[mx]
+            left = vrec[mx - 1][0][:, 15] if mx > 0 else None
+            lu = vrec[mx - 1][1][:, 7] if mx > 0 else None
+            lv = vrec[mx - 1][2][:, 7] if mx > 0 else None
+            top16 = top_y[16 * mx:16 * mx + 16]
+            ph = predict_luma16(LUMA_H, None, left, None) \
+                if mx > 0 else None
+            pdc = predict_luma16(LUMA_DC, top16, left, None)
+            c_v = rdo.satd16_np(src - py.astype(np.int32))
+            c_h = rdo.satd16_np(src - ph.astype(np.int32)) \
+                if mx > 0 else INF
+            c_dc = rdo.satd16_np(src - pdc.astype(np.int32))
+            tu8 = top_u[8 * mx:8 * mx + 8]
+            tv8 = top_v[8 * mx:8 * mx + 8]
+            phu = predict_chroma8(CHROMA_H, None, lu, None) \
+                if mx > 0 else None
+            phv = predict_chroma8(CHROMA_H, None, lv, None) \
+                if mx > 0 else None
+            pdcu = predict_chroma8(CHROMA_DC, tu8, lu, None)
+            pdcv = predict_chroma8(CHROMA_DC, tv8, lv, None)
+            cc_v = (rdo.satd8_np(su - pu.astype(np.int32))
+                    + rdo.satd8_np(sv - pv.astype(np.int32)))
+            cc_h = (rdo.satd8_np(su - phu.astype(np.int32))
+                    + rdo.satd8_np(sv - phv.astype(np.int32))) \
+                if mx > 0 else INF
+            cc_dc = (rdo.satd8_np(su - pdcu.astype(np.int32))
+                     + rdo.satd8_np(sv - pdcv.astype(np.int32)))
+            # strict-< argmin, candidate order (V, H, DC)
+            best_y, ymode_alt, pya = c_v, LUMA_V, py
+            if c_h < best_y:
+                best_y, ymode_alt, pya = c_h, LUMA_H, ph
+            if c_dc < best_y:
+                best_y, ymode_alt, pya = c_dc, LUMA_DC, pdc
+            best_c, cmode_alt, pua, pva = cc_v, CHROMA_V, pu, pv
+            if cc_h < best_c:
+                best_c, cmode_alt, pua, pva = cc_h, CHROMA_H, phu, phv
+            if cc_dc < best_c:
+                best_c, cmode_alt, pua, pva = cc_dc, CHROMA_DC, pdcu, pdcv
+            desired[mx] = (best_y + best_c) < (c_v + cc_v)
+            choice.append((ymode_alt, cmode_alt, pya, pua, pva))
+        allowed = _greedy_allowed_np(desired)
+
+        # stage 3: final encode (switched MBs re-encode; the rest keep
+        # their vertical prediction)
+        for mx in range(mbw):
+            mi = my * mbw + mx
+            if allowed[mx]:
+                ymode_alt, cmode_alt, pya, pua, pva = choice[mx]
+                store_mb(mi, my, mx, ymode_alt, cmode_alt, pya, pua, pva)
+            else:
+                py, pu, pv = preds_v[mx]
+                store_mb(mi, my, mx, LUMA_V, CHROMA_V, py, pu, pv)
     return levels, (recon_y, recon_u, recon_v)
 
 
@@ -178,7 +355,7 @@ def mb_cbp(levels: FrameLevels, mi: int) -> tuple[int, int]:
 def pack_slice(levels: FrameLevels, mbw: int, mbh: int, sps: SPS, pps: PPS,
                qp: int, frame_num: int = 0, idr: bool = True,
                idr_pic_id: int = 0, native: bool | None = None,
-               first_mb: int = 0) -> bytes:
+               first_mb: int = 0, deblock: bool = False) -> bytes:
     """Entropy-pack one I slice into an Annex-B NAL unit.
 
     `levels`/`mbw`/`mbh` describe the SLICE's macroblocks; with a
@@ -196,6 +373,7 @@ def pack_slice(levels: FrameLevels, mbw: int, mbh: int, sps: SPS, pps: PPS,
     header = SliceHeader(
         slice_type=SLICE_TYPE_I, frame_num=frame_num, idr=idr, qp=qp,
         idr_pic_id=idr_pic_id, first_mb=first_mb,
+        deblock_idc=0 if deblock else 1,
     )
     header.write(bw, sps, pps)
 
@@ -207,7 +385,7 @@ def pack_slice(levels: FrameLevels, mbw: int, mbh: int, sps: SPS, pps: PPS,
             ebsp = native_mod.pack_islice(
                 hdr_bytes, hdr_bits, levels.luma_mode, levels.chroma_mode,
                 levels.luma_dc, levels.luma_ac, levels.chroma_dc,
-                levels.chroma_ac, mbw, mbh)
+                levels.chroma_ac, mbw, mbh, qp_delta=levels.qp_delta)
             start = b"\x00\x00\x00\x01"
             nal_header = bytes([(3 << 5) | (NAL_SLICE_IDR if idr else 1)])
             return start + nal_header + ebsp
@@ -218,6 +396,11 @@ def pack_slice(levels: FrameLevels, mbw: int, mbh: int, sps: SPS, pps: PPS,
     luma_counts = np.zeros((4 * mbh, 4 * mbw), np.int32)
     chroma_counts = np.zeros((2, 2 * mbh, 2 * mbw), np.int32)
 
+    # mb_qp_delta chains: each MB signals its qp relative to the
+    # PREVIOUS MB's (§7.4.5); levels.qp_delta holds offsets vs the
+    # slice qp, so the coded value is the successive difference.
+    dqp = levels.qp_delta
+    prev_off = 0
     for my in range(mbh):
         for mx in range(mbw):
             mi = my * mbw + mx
@@ -226,7 +409,11 @@ def pack_slice(levels: FrameLevels, mbw: int, mbh: int, sps: SPS, pps: PPS,
                 + 12 * (1 if cbp_luma else 0)
             bw.ue(mb_type)
             bw.ue(int(levels.chroma_mode[mi]))   # intra_chroma_pred_mode
-            bw.se(0)                             # mb_qp_delta
+            if dqp is None:
+                bw.se(0)                         # mb_qp_delta
+            else:
+                bw.se(int(dqp[mi]) - prev_off)
+                prev_off = int(dqp[mi])
 
             # Luma DC: nC from blkIdx 0 neighbors.
             by0, bx0 = 4 * my, 4 * mx
@@ -279,10 +466,21 @@ class H264Encoder:
     `use_jax=False` for the numpy reference implementation.
     """
 
-    def __init__(self, meta: VideoMeta, qp: int = 27, use_jax: bool = True):
+    def __init__(self, meta: VideoMeta, qp: int = 27, use_jax: bool = True,
+                 rd=None):
+        from .rdo import RD_OFF
+
         self.meta = meta
         self.qp = qp
         self.use_jax = use_jax
+        self.rd = rd if rd is not None else RD_OFF
+        if self.rd.deblock or self.rd.pskip:
+            # v1 all-intra scope: no recon chain to filter, no inter
+            # MBs to skip — the GOP path (encode_gop / the sharded
+            # encoders) carries those features.
+            raise ValueError(
+                "H264Encoder (all-intra) supports mode_decision/aq "
+                "only; deblock/pskip need the GOP path")
         self.sps = SPS(width=meta.width, height=meta.height,
                        fps_num=meta.fps_num, fps_den=meta.fps_den)
         self.pps = PPS(init_qp=qp)
@@ -294,9 +492,9 @@ class H264Encoder:
 
             if self._jax_fn is None:
                 self._jax_fn = jaxcore.build_intra_encoder(
-                    y.shape, self.qp)
+                    y.shape, self.qp, self.rd)
             return self._jax_fn(y, u, v)
-        levels, _ = encode_frame_arrays(y, u, v, self.qp)
+        levels, _ = encode_frame_arrays(y, u, v, self.qp, rd=self.rd)
         return levels
 
     def encode_frame(self, frame: Frame, frame_num: int = 0,
@@ -334,7 +532,7 @@ def encode_frames(frames: list[Frame], meta: VideoMeta, qp: int = 27,
 
 def encode_gop(frames: list[Frame], meta: VideoMeta, qp: int = 27,
                idr_pic_id: int = 0, with_headers: bool = True,
-               return_recon: bool = False):
+               return_recon: bool = False, rd=None):
     """Encode a closed GOP: frame 0 IDR, frames 1..F-1 inter-coded (P).
 
     The whole GOP's compute (intra frame + motion search / compensation /
@@ -348,7 +546,10 @@ def encode_gop(frames: list[Frame], meta: VideoMeta, qp: int = 27,
 
     from ...core.types import ChromaFormat
     from . import jaxinter
+    from .rdo import RD_OFF
 
+    if rd is None:
+        rd = RD_OFF
     if not frames:
         raise ValueError("empty GOP")
     bad = next((f for f in frames
@@ -365,46 +566,71 @@ def encode_gop(frames: list[Frame], meta: VideoMeta, qp: int = 27,
 
     out = jaxinter.encode_gop_jit(ys, us, vs, jnp.asarray(qp),
                                   mbw=mbw, mbh=mbh,
-                                  emit_recon=return_recon)
+                                  emit_recon=return_recon, rd=rd)
     if return_recon:
         (intra, pouts, recons) = jax.device_get(out)
     else:
         (intra, pouts) = jax.device_get(out)
-    il_dc, il_ac, ic_dc, ic_ac = intra
-    mv, l16, cdc, cac = pouts
 
     sps = SPS(width=meta.width, height=meta.height,
               fps_num=meta.fps_num, fps_den=meta.fps_den)
     pps = PPS(init_qp=qp)
     nals = pack_gop_slices(intra, pouts, len(frames), mbw, mbh, sps, pps,
-                           qp, idr_pic_id, with_headers=with_headers)
+                           qp, idr_pic_id, with_headers=with_headers,
+                           rd=rd)
     stream = b"".join(nals)
     if return_recon:
         return stream, recons
     return stream
 
 
+def unpack_mode16(mode16: np.ndarray):
+    """The transfer's packed per-MB mode word → (luma_mode,
+    chroma_mode) int32 arrays (jaxcore._mode_tail's inverse)."""
+    m = np.asarray(mode16, np.int32)
+    return m & 15, m >> 4
+
+
 def _gop_slice_thunks(intra, pack_p, num_frames: int, mbw: int, mbh: int,
                       sps: SPS, pps: PPS, qp: int, idr_pic_id: int,
-                      with_headers: bool) -> list:
+                      with_headers: bool, rd=None) -> list:
     """Per-slice pack closures for one GOP (IDR thunk first, then one
     per P frame). A GOP's slices are independent bit-strings until the
     final concat, so callers may run the thunks on a thread pool (the
     native packer releases the GIL for the C call); running them in
     order serially yields the same bytes. Every GOP-pack entry point
     funnels through here so the bit-identity contract between paths
-    cannot drift in the IDR/header logic."""
-    il_dc, il_ac, ic_dc, ic_ac = intra
-    luma_mode, chroma_mode = _mode_policy(mbw, mbh)
+    cannot drift in the IDR/header logic.
+
+    `intra` is the 4-tuple of blocked level arrays, or — when the
+    encode shipped the per-MB side channel (rd.ships_modes) — a
+    6-tuple with (mode16, dqp16) appended."""
+    from .rdo import RD_OFF
+
+    if rd is None:
+        rd = RD_OFF
+    if len(intra) == 6:
+        il_dc, il_ac, ic_dc, ic_ac, mode16, dqp16 = intra
+        luma_mode, chroma_mode = unpack_mode16(mode16)
+        qp_delta = np.asarray(dqp16, np.int32)
+        if not np.any(qp_delta):
+            qp_delta = None
+    else:
+        il_dc, il_ac, ic_dc, ic_ac = intra
+        luma_mode, chroma_mode = _mode_policy(mbw, mbh)
+        qp_delta = None
     intra_levels = FrameLevels(
         luma_mode=luma_mode, chroma_mode=chroma_mode,
-        luma_dc=il_dc, luma_ac=il_ac, chroma_dc=ic_dc, chroma_ac=ic_ac)
+        luma_dc=il_dc, luma_ac=il_ac, chroma_dc=ic_dc, chroma_ac=ic_ac,
+        qp_delta=qp_delta)
     head = sps.to_nal() + pps.to_nal() if with_headers else b""
+    deblock = bool(rd.deblock)
 
     def pack_idr():
         return head + pack_slice(intra_levels, mbw, mbh, sps, pps, qp,
                                  frame_num=0, idr=True,
-                                 idr_pic_id=idr_pic_id % 65536)
+                                 idr_pic_id=idr_pic_id % 65536,
+                                 deblock=deblock)
 
     thunks = [pack_idr]
     for i in range(num_frames - 1):
@@ -423,38 +649,40 @@ def run_slice_thunks(thunks: list, pool=None) -> list[bytes]:
 
 def _pack_gop_common(intra, pack_p, num_frames: int, mbw: int, mbh: int,
                      sps: SPS, pps: PPS, qp: int, idr_pic_id: int,
-                     with_headers: bool, pool=None) -> list[bytes]:
+                     with_headers: bool, pool=None, rd=None) -> list[bytes]:
     """Shared host half of GOP entropy packing: IDR slice from blocked
     intra levels + one P slice per remaining frame via `pack_p(i,
     frame_num)`, optionally fanned across `pool` at slice granularity."""
     return run_slice_thunks(
         _gop_slice_thunks(intra, pack_p, num_frames, mbw, mbh, sps, pps,
-                          qp, idr_pic_id, with_headers), pool)
+                          qp, idr_pic_id, with_headers, rd=rd), pool)
 
 
 def gop_slice_thunks_planes(intra, planes, num_frames: int, mbw: int,
                             mbh: int, sps: SPS, pps: PPS, qp: int,
                             idr_pic_id: int,
-                            with_headers: bool = True) -> list:
+                            with_headers: bool = True, rd=None) -> list:
     """Per-slice pack thunks for one PLANE-layout GOP (see
     pack_gop_slices_planes for the array contract). dispatch.collect_wave
     submits these so slices from ALL of a wave's GOPs pack concurrently
     on the pack pool instead of GOP-by-GOP."""
     from . import inter as inter_mod
 
+    deblock = bool(rd.deblock) if rd is not None else False
     mv8, lp, udc, vdc, uac, vac = planes
     return _gop_slice_thunks(
         intra,
         lambda i, fn: inter_mod.pack_p_slice_plane(
             mv8[i], lp[i], udc[i], vdc[i], uac[i], vac[i], mbw, mbh,
-            sps, pps, qp, frame_num=fn),
-        num_frames, mbw, mbh, sps, pps, qp, idr_pic_id, with_headers)
+            sps, pps, qp, frame_num=fn, deblock=deblock),
+        num_frames, mbw, mbh, sps, pps, qp, idr_pic_id, with_headers,
+        rd=rd)
 
 
 def pack_gop_slices_planes(intra, planes, num_frames: int, mbw: int,
                            mbh: int, sps: SPS, pps: PPS, qp: int,
                            idr_pic_id: int, with_headers: bool = True,
-                           pool=None) -> list[bytes]:
+                           pool=None, rd=None) -> list[bytes]:
     """Entropy-pack one GOP whose P frames arrive as PLANE-layout level
     arrays (the sharded transfer format, jaxinter.encode_gop_planes):
     planes = (mv8 (F-1,nmb,2) int8, luma planes (F-1,H,W) int16,
@@ -463,26 +691,29 @@ def pack_gop_slices_planes(intra, planes, num_frames: int, mbw: int,
     Bit-identical to pack_gop_slices on the equivalent blocked arrays."""
     return run_slice_thunks(
         gop_slice_thunks_planes(intra, planes, num_frames, mbw, mbh, sps,
-                                pps, qp, idr_pic_id, with_headers), pool)
+                                pps, qp, idr_pic_id, with_headers, rd=rd),
+        pool)
 
 
 def pack_gop_slices(intra, pouts, num_frames: int, mbw: int, mbh: int,
                     sps: SPS, pps: PPS, qp: int, idr_pic_id: int,
-                    with_headers: bool = True, pool=None) -> list[bytes]:
+                    with_headers: bool = True, pool=None,
+                    rd=None) -> list[bytes]:
     """Entropy-pack one GOP's slices from BLOCKED device level arrays
     (the single-device encode_gop path).
 
-    intra: (luma_dc, luma_ac, chroma_dc, chroma_ac); pouts: the P
-    frames' (mv, luma16, chroma_dc, chroma_ac), leading dim >= num
-    frames - 1 (extra tail-padding entries are ignored).
+    intra: (luma_dc, luma_ac, chroma_dc, chroma_ac[, mode16, dqp16]);
+    pouts: the P frames' (mv, luma16, chroma_dc, chroma_ac), leading
+    dim >= num frames - 1 (extra tail-padding entries are ignored).
     """
     from . import inter as inter_mod
 
+    deblock = bool(rd.deblock) if rd is not None else False
     mv, l16, cdc, cac = pouts
     return _pack_gop_common(
         intra,
         lambda i, fn: inter_mod.pack_p_slice(
             mv[i], l16[i], cdc[i], cac[i], mbw, mbh, sps, pps, qp,
-            frame_num=fn),
+            frame_num=fn, deblock=deblock),
         num_frames, mbw, mbh, sps, pps, qp, idr_pic_id, with_headers,
-        pool=pool)
+        pool=pool, rd=rd)

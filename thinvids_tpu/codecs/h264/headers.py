@@ -221,7 +221,16 @@ class SliceHeader:
     qp: int
     idr_pic_id: int = 0
     first_mb: int = 0
-    disable_deblocking: bool = True
+    #: disable_deblocking_filter_idc (§7.4.3): 1 = off (the historical
+    #: default — encoder recon needs no filter), 0 = §8.7 in-loop
+    #: deblocking across the whole picture (the `deblock` RD feature),
+    #: 2 = filter inside slices only (parsed, but neither emitted by
+    #: this encoder nor decoded by the in-repo decoder).
+    deblock_idc: int = 1
+
+    @property
+    def disable_deblocking(self) -> bool:
+        return self.deblock_idc == 1
 
     def write(self, bw: BitWriter, sps: SPS, pps: PPS) -> None:
         bw.ue(self.first_mb)
@@ -242,10 +251,10 @@ class SliceHeader:
             bw.write_bit(0)      # adaptive_ref_pic_marking_mode_flag
         bw.se(self.qp - pps.init_qp)                    # slice_qp_delta
         if pps.deblocking_control_present:
-            bw.ue(1 if self.disable_deblocking else 0)  # disable_deblocking_idc
-            if not self.disable_deblocking:
-                bw.se(0)
-                bw.se(0)
+            bw.ue(self.deblock_idc)          # disable_deblocking_filter_idc
+            if self.deblock_idc != 1:
+                bw.se(0)                     # slice_alpha_c0_offset_div2
+                bw.se(0)                     # slice_beta_offset_div2
 
     @classmethod
     def parse(cls, br: BitReader, sps: SPS, pps: PPS, nal_type: int,
@@ -273,13 +282,14 @@ class SliceHeader:
                 if br.read_bit():
                     raise ValueError("adaptive ref marking not supported")
         qp = pps.init_qp + br.se()
-        disable_deblocking = True
+        idc = 1
         if pps.deblocking_control_present:
             idc = br.ue()
-            disable_deblocking = idc == 1
             if idc != 1:
-                br.se()
-                br.se()
+                off_a, off_b = br.se(), br.se()
+                if off_a or off_b:
+                    raise ValueError(
+                        "nonzero deblock filter offsets not supported")
         return cls(slice_type=st, frame_num=frame_num, idr=idr, qp=qp,
                    idr_pic_id=idr_pic_id, first_mb=first_mb,
-                   disable_deblocking=disable_deblocking)
+                   deblock_idc=idc)

@@ -25,8 +25,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import rdo
 from .encoder import FrameLevels, _mode_policy
 from .intra import LUMA_BLOCK_ORDER
+from .rdo import RD_OFF
 from .transform import MF_TABLE, V_TABLE, ZIGZAG_4x4, CHROMA_QP_TABLE
 
 _MF = jnp.asarray(MF_TABLE)          # (6, 4, 4)
@@ -71,9 +73,17 @@ def _inv4(d):
 
 
 def _quant(w, qp, skip_dc):
-    qbits = 15 + qp // 6
+    """Quantize (n, B, 4, 4) coefficient blocks. `qp` may be a scalar
+    or an (n,) per-MB vector (perceptual AQ) — with a scalar the math
+    reproduces the historical bits exactly."""
+    qp = jnp.asarray(qp)
+    if qp.ndim:
+        mf = _MF[qp % 6][:, None]            # (n, 1, 4, 4)
+        qbits = (15 + qp // 6)[:, None, None, None]
+    else:
+        mf = _MF[qp % 6]
+        qbits = 15 + qp // 6
     f = (1 << qbits) // 3
-    mf = _MF[qp % 6]
     z = (jnp.abs(w) * mf + f) >> qbits
     z = jnp.where(w < 0, -z, z)
     if skip_dc:
@@ -82,6 +92,9 @@ def _quant(w, qp, skip_dc):
 
 
 def _dequant(z, qp):
+    qp = jnp.asarray(qp)
+    if qp.ndim:
+        return (z * _V[qp % 6][:, None]) << (qp // 6)[:, None, None, None]
     return (z * _V[qp % 6]) << (qp // 6)
 
 
@@ -95,35 +108,46 @@ def _inv_zigzag(seq):
     return out.reshape(*seq.shape[:-1], 4, 4)
 
 
+def _dc_dims(qp, ndim: int):
+    """(qbits, mf00, vls, qp_b) broadcastable over an (n, ...) DC array
+    when `qp` is an (n,) vector, plain scalars otherwise."""
+    qp = jnp.asarray(qp)
+    if qp.ndim:
+        shape = (qp.shape[0],) + (1,) * (ndim - 1)
+        return ((15 + qp // 6).reshape(shape),
+                _MF[qp % 6, 0, 0].reshape(shape),
+                (_V[qp % 6, 0, 0] * 16).reshape(shape),
+                qp.reshape(shape))
+    return 15 + qp // 6, _MF[qp % 6, 0, 0], _V[qp % 6, 0, 0] * 16, qp
+
+
 def _luma_dc_quant(wd, qp):
-    qbits = 15 + qp // 6
+    qbits, mf00, _, _ = _dc_dims(qp, wd.ndim)
     f = (1 << qbits) // 3
-    mf00 = _MF[qp % 6, 0, 0]
     z = (jnp.abs(wd) * mf00 + 2 * f) >> (qbits + 1)
     return jnp.where(wd < 0, -z, z)
 
 
 def _luma_dc_dequant(z, qp):
     f = jnp.einsum("ij,...jk,lk->...il", _H4, z, _H4)
-    ls = _V[qp % 6, 0, 0] * 16
-    hi = (f * ls) << jnp.maximum(qp // 6 - 6, 0)
-    shift = jnp.maximum(6 - qp // 6, 1)
+    _, _, ls, qp_b = _dc_dims(qp, f.ndim)
+    hi = (f * ls) << jnp.maximum(qp_b // 6 - 6, 0)
+    shift = jnp.maximum(6 - qp_b // 6, 1)
     lo = (f * ls + (1 << (shift - 1))) >> shift
-    return jnp.where(qp >= 36, hi, lo)
+    return jnp.where(qp_b >= 36, hi, lo)
 
 
 def _chroma_dc_quant(wd, qp):
-    qbits = 15 + qp // 6
+    qbits, mf00, _, _ = _dc_dims(qp, wd.ndim)
     f = (1 << qbits) // 3
-    mf00 = _MF[qp % 6, 0, 0]
     z = (jnp.abs(wd) * mf00 + 2 * f) >> (qbits + 1)
     return jnp.where(wd < 0, -z, z)
 
 
 def _chroma_dc_dequant(z, qp):
     f = jnp.einsum("ij,...jk,lk->...il", _H2, z, _H2)
-    ls = _V[qp % 6, 0, 0] * 16
-    return ((f * ls) << (qp // 6)) >> 5
+    _, _, ls, qp_b = _dc_dims(qp, f.ndim)
+    return ((f * ls) << (qp_b // 6)) >> 5
 
 
 def _luma_mb_batch(src, pred, qp):
@@ -170,46 +194,225 @@ def _chroma_mb_batch(src, pred, qpc):
     return dc_lev, ac_lev, rec
 
 
-@functools.partial(jax.jit, static_argnames=("mbw", "mbh"))
-def _encode_intra(y, u, v, qp, *, mbw: int, mbh: int):
+@functools.partial(jax.jit, static_argnames=("mbw", "mbh", "rd"))
+def _encode_intra(y, u, v, qp, *, mbw: int, mbh: int, rd=RD_OFF):
     """Jitted intra compute: level arrays only (recon DCE'd away)."""
-    return _intra_core(y, u, v, qp, mbw=mbw, mbh=mbh)[:4]
+    return _intra_core(y, u, v, qp, mbw=mbw, mbh=mbh, rd=rd)[:4]
 
 
-def _intra_core(y, u, v, qp, *, mbw: int, mbh: int):
+def _satd16(resid):
+    """(n, 16, 16) int32 residual → (n,) SATD (sum |4x4 Hadamard| / 2;
+    the intra mode-decision cost — rdo.satd16_np is the numpy twin)."""
+    n = resid.shape[0]
+    b = resid.reshape(n, 4, 4, 4, 4).transpose(0, 1, 3, 2, 4)
+    t = jnp.einsum("ij,nbcjk,lk->nbcil", _H4, b, _H4)
+    return jnp.abs(t).sum(axis=(1, 2, 3, 4)) // 2
+
+
+def _satd8(resid):
+    """(n, 8, 8) int32 residual → (n,) SATD."""
+    n = resid.shape[0]
+    b = resid.reshape(n, 2, 4, 2, 4).transpose(0, 1, 3, 2, 4)
+    t = jnp.einsum("ij,nbcjk,lk->nbcil", _H4, b, _H4)
+    return jnp.abs(t).sum(axis=(1, 2, 3, 4)) // 2
+
+
+def _mb_activity(y32, mbw: int, mbh: int):
+    """(nmb,) int32 integer luma activity — the device twin of
+    rdo.mb_activity_np (uint32 throughout; exact)."""
+    mb = y32[:16 * mbh, :16 * mbw].astype(jnp.uint32)
+    mb = mb.reshape(mbh, 16, mbw, 16).transpose(0, 2, 1, 3)
+    mb = mb.reshape(mbh * mbw, 256)
+    s = mb.sum(axis=1)
+    s2 = (mb * mb).sum(axis=1)
+    v = 256 * s2 - s * s
+    act = jnp.zeros(mbh * mbw, jnp.int32)
+    for k in range(1, rdo.AQ_ACT_BITS + 1):
+        act = act + (v >= jnp.uint32((1 << k) - 1)).astype(jnp.int32)
+    return act
+
+
+def _aq_qp_map(y32, qp, aq_q: int, mbw: int, mbh: int):
+    """(nmb,) per-MB QP for one intra frame under perceptual AQ —
+    integer mirror of rdo.aq_offsets_from_activity."""
+    act = _mb_activity(y32, mbw, mbh)
+    nmb = mbw * mbh
+    total = act.sum()
+    num = aq_q * (act * nmb - total)
+    den = rdo.AQ_QUANT * nmb
+    delta = (2 * num + den) // (2 * den)
+    delta = jnp.clip(delta, -rdo.AQ_MAX_DELTA, rdo.AQ_MAX_DELTA)
+    return jnp.clip(qp + delta, 0, 51).astype(jnp.int32)
+
+
+def _greedy_allowed(desired):
+    """Vectorized greedy left-to-right selection: allowed[c] =
+    desired[c] & !allowed[c-1]. Within each run of consecutive desired
+    MBs the sequential recurrence alternates starting True at the run
+    head, so allowed = desired & (even offset from the run start) —
+    cummax of the run-start indices replaces the scan."""
+    n = desired.shape[0]
+    idx = jnp.arange(n)
+    prev = jnp.concatenate([jnp.zeros(1, jnp.bool_), desired[:-1]])
+    run_start = desired & ~prev
+    start_idx = jax.lax.cummax(jnp.where(run_start, idx, -1))
+    return desired & (((idx - start_idx) % 2) == 0)
+
+
+#: large finite cost for unavailable candidates (strict-< selection
+#: keeps the earlier candidate on ties, so this never wins)
+_COST_INF = jnp.int32(1 << 29)
+
+
+def _pick3(c0, m0, c1, m1, c2, m2):
+    """Strict-< argmin over three (cost, mode) pairs, earlier wins."""
+    best, mode = c0, jnp.full_like(c0, m0)
+    take = c1 < best
+    best = jnp.where(take, c1, best)
+    mode = jnp.where(take, m1, mode)
+    take = c2 < best
+    best = jnp.where(take, c2, best)
+    mode = jnp.where(take, m2, mode)
+    return best, mode
+
+
+def _chroma_dc_pred_row(ts4, ls4, avail_left, avail_top):
+    """(n, 8, 8) chroma DC predictions per §8.3.4 quadrant rules from
+    per-MB quarter sums ts4 (n, 2) [top halves] and ls4 (n, 2) [left
+    halves]; avail_* are (n,) bools. Matches intra.predict_chroma8's
+    availability fallbacks for every (left, top) combination that
+    occurs in a slice (at least one of them available)."""
+    n = ts4.shape[0]
+    t0, t1 = ts4[:, 0], ts4[:, 1]
+    l0, l1 = ls4[:, 0], ls4[:, 1]
+    both = avail_left & avail_top
+    # quadrant (0,0): t0+l0 both; else the available one
+    q00 = jnp.where(both, (t0 + l0 + 4) >> 3,
+                    jnp.where(avail_top, (t0 + 2) >> 2, (l0 + 2) >> 2))
+    # (1,0): prefers its own top quarter
+    q10 = jnp.where(avail_top, (t1 + 2) >> 2, (l0 + 2) >> 2)
+    # (0,1): prefers its own left quarter
+    q01 = jnp.where(avail_left, (l1 + 2) >> 2, (t0 + 2) >> 2)
+    # (1,1): both -> t1+l1; else the available one
+    q11 = jnp.where(both, (t1 + l1 + 4) >> 3,
+                    jnp.where(avail_top, (t1 + 2) >> 2, (l1 + 2) >> 2))
+    top = jnp.concatenate([
+        jnp.broadcast_to(q00[:, None, None], (n, 4, 4)),
+        jnp.broadcast_to(q10[:, None, None], (n, 4, 4))], axis=2)
+    bot = jnp.concatenate([
+        jnp.broadcast_to(q01[:, None, None], (n, 4, 4)),
+        jnp.broadcast_to(q11[:, None, None], (n, 4, 4))], axis=2)
+    return jnp.concatenate([top, bot], axis=1)
+
+
+def _intra_core(y, u, v, qp, *, mbw: int, mbh: int, rd=RD_OFF):
+    """Intra compute for one (padded) frame.
+
+    Returns (luma_dc, luma_ac, chroma_dc, chroma_ac, recon_y, recon_u,
+    recon_v, luma_mode, chroma_mode, qp_delta): the historical seven
+    arrays plus the per-MB mode/QP side channel — with `rd` off the
+    modes are exactly encoder._mode_policy's raster and qp_delta is
+    all-zero (and the level/recon arrays are bit-identical to the
+    historical program).
+
+    With ``rd.mode_decision`` the fixed V/H/DC raster becomes a per-MB
+    SATD decision; rows stay data-parallel via a two-stage schedule:
+    every MB of a row first encodes VERTICAL (its prediction needs only
+    the carried row above), then MBs whose H/DC candidate (predicted
+    from the LEFT neighbor's vertical-mode recon) beats V by SATD are
+    switched — greedily constrained so a switched MB's left neighbor
+    always kept V, which makes the left-recon assumption exact. Row 0
+    (slice-local: no row above) decides H vs DC inside its existing
+    left-to-right scan, where the true recon is available — no
+    constraint needed. With ``rd.aq_q`` the quantizer runs on a per-MB
+    QP map (qp + variance-AQ offsets, _aq_qp_map).
+    """
     qp = qp.astype(jnp.int32)
-    qpc = _QPC[jnp.clip(qp, 0, 51)]
     y = y.astype(jnp.int32)
     u = u.astype(jnp.int32)
     v = v.astype(jnp.int32)
+    zero = _varying_zero(y)        # see _varying_zero: shard_map carries
+    qpc = _QPC[jnp.clip(qp, 0, 51)]
+    if rd.aq_q > 0:
+        qp_mb = _aq_qp_map(y, qp, rd.aq_q, mbw, mbh) + zero   # (nmb,)
+        qp_rows = qp_mb.reshape(mbh, mbw)
+        qpc_rows = _QPC[jnp.clip(qp_mb, 0, 51)].reshape(mbh, mbw)
+        qp_delta = (qp_mb - qp).astype(jnp.int32)
+    else:
+        # flat QP: the scans below fall back to the SCALAR quantizer
+        # arguments (the per-row vectors are dead and DCE'd), so the
+        # compiled default program is the historical one.
+        qp_rows = jnp.broadcast_to(qp, (mbh, mbw))
+        qpc_rows = jnp.broadcast_to(qpc, (mbh, mbw))
+        qp_delta = jnp.zeros(mbw * mbh, jnp.int32) + zero
 
-    # --- row 0: sequential over MBs (DC for MB0, horizontal after) ---
+    # --- row 0: sequential over MBs (left-only dependencies) ---
     y_row0 = y[:16].reshape(16, mbw, 16).transpose(1, 0, 2)      # (mbw,16,16)
     u_row0 = u[:8].reshape(8, mbw, 8).transpose(1, 0, 2)
     v_row0 = v[:8].reshape(8, mbw, 8).transpose(1, 0, 2)
 
     def row0_step(carry, x):
         ly, lu, lv, idx = carry
-        sy, su, sv = x
-        pred_y = jnp.where(idx == 0, jnp.full((16, 16), 128, jnp.int32),
-                           jnp.tile(ly[:, None], (1, 16)))
-        pred_u = jnp.where(idx == 0, jnp.full((8, 8), 128, jnp.int32),
-                           jnp.tile(lu[:, None], (1, 8)))
-        pred_v = jnp.where(idx == 0, jnp.full((8, 8), 128, jnp.int32),
-                           jnp.tile(lv[:, None], (1, 8)))
-        ydc, yac, yrec = _luma_mb_batch(sy[None], pred_y[None], qp)
-        udc, uac, urec = _chroma_mb_batch(su[None], pred_u[None], qpc)
-        vdc, vac, vrec = _chroma_mb_batch(sv[None], pred_v[None], qpc)
+        sy, su, sv, qp1, qpc1 = x
+        pred_h_y = jnp.tile(ly[:, None], (1, 16))
+        pred_h_u = jnp.tile(lu[:, None], (1, 8))
+        pred_h_v = jnp.tile(lv[:, None], (1, 8))
+        if rd.mode_decision:
+            # candidates: H vs DC (left-only), decided by SATD; MB 0
+            # keeps DC-128 (no neighbors).
+            dc_y = jnp.full((16, 16), (ly.sum() + 8) >> 4, jnp.int32)
+            c_h = _satd16((sy - pred_h_y)[None])[0]
+            c_dc = _satd16((sy - dc_y)[None])[0]
+            lsum_u = jnp.stack([lu[:4].sum(), lu[4:].sum()])
+            lsum_v = jnp.stack([lv[:4].sum(), lv[4:].sum()])
+            dc_u = _chroma_dc_pred_row(
+                jnp.zeros((1, 2), jnp.int32), lsum_u[None],
+                jnp.ones(1, bool), jnp.zeros(1, bool))[0]
+            dc_v = _chroma_dc_pred_row(
+                jnp.zeros((1, 2), jnp.int32), lsum_v[None],
+                jnp.ones(1, bool), jnp.zeros(1, bool))[0]
+            cc_h = (_satd8((su - pred_h_u)[None])
+                    + _satd8((sv - pred_h_v)[None]))[0]
+            cc_dc = (_satd8((su - dc_u)[None])
+                     + _satd8((sv - dc_v)[None]))[0]
+            dc128_y = jnp.full((16, 16), 128, jnp.int32)
+            dc128_c = jnp.full((8, 8), 128, jnp.int32)
+            take_dc = c_dc < c_h
+            pred_y = jnp.where(idx == 0, dc128_y,
+                               jnp.where(take_dc, dc_y, pred_h_y))
+            ymode = jnp.where(idx == 0, 2, jnp.where(take_dc, 2, 1))
+            take_cdc = cc_dc < cc_h
+            pred_u = jnp.where(idx == 0, dc128_c,
+                               jnp.where(take_cdc, dc_u, pred_h_u))
+            pred_v = jnp.where(idx == 0, dc128_c,
+                               jnp.where(take_cdc, dc_v, pred_h_v))
+            cmode = jnp.where(idx == 0, 0, jnp.where(take_cdc, 0, 1))
+        else:
+            pred_y = jnp.where(idx == 0,
+                               jnp.full((16, 16), 128, jnp.int32),
+                               pred_h_y)
+            pred_u = jnp.where(idx == 0, jnp.full((8, 8), 128, jnp.int32),
+                               pred_h_u)
+            pred_v = jnp.where(idx == 0, jnp.full((8, 8), 128, jnp.int32),
+                               pred_h_v)
+            ymode = jnp.where(idx == 0, 2, 1)     # DC then horizontal
+            cmode = jnp.where(idx == 0, 0, 1)
+        qp_mb1 = qp1 if rd.aq_q else qp
+        qpc_mb1 = qpc1 if rd.aq_q else qpc
+        ydc, yac, yrec = _luma_mb_batch(sy[None], pred_y[None], qp_mb1)
+        udc, uac, urec = _chroma_mb_batch(su[None], pred_u[None], qpc_mb1)
+        vdc, vac, vrec = _chroma_mb_batch(sv[None], pred_v[None], qpc_mb1)
         carry = (yrec[0, :, -1], urec[0, :, -1], vrec[0, :, -1], idx + 1)
         return carry, (ydc[0], yac[0], udc[0], uac[0], vdc[0], vac[0],
-                       yrec[0], urec[0], vrec[0])
+                       yrec[0], urec[0], vrec[0], ymode, cmode)
 
-    zero = _varying_zero(y)        # see _varying_zero: shard_map carries
     init = (jnp.zeros(16, jnp.int32) + zero, jnp.zeros(8, jnp.int32) + zero,
             jnp.zeros(8, jnp.int32) + zero, zero)
-    _, row0_out = jax.lax.scan(row0_step, init, (y_row0, u_row0, v_row0))
+    _, row0_out = jax.lax.scan(
+        row0_step, init,
+        (y_row0, u_row0, v_row0, qp_rows[0], qpc_rows[0]))
     (r0_ydc, r0_yac, r0_udc, r0_uac, r0_vdc, r0_vac,
-     r0_yrec, r0_urec, r0_vrec) = row0_out
+     r0_yrec, r0_urec, r0_vrec, r0_ymode, r0_cmode) = row0_out
     bottom_y = r0_yrec[:, -1, :].reshape(-1)                     # (W,)
     bottom_u = r0_urec[:, -1, :].reshape(-1)
     bottom_v = r0_vrec[:, -1, :].reshape(-1)
@@ -222,21 +425,99 @@ def _intra_core(y, u, v, qp, *, mbw: int, mbh: int):
 
         def row_step(carry, x):
             by, bu, bv = carry
-            sy, su, sv = x                                       # (mbw,16,16)
-            pred_y = jnp.broadcast_to(by.reshape(mbw, 1, 16), (mbw, 16, 16))
-            pred_u = jnp.broadcast_to(bu.reshape(mbw, 1, 8), (mbw, 8, 8))
-            pred_v = jnp.broadcast_to(bv.reshape(mbw, 1, 8), (mbw, 8, 8))
-            ydc, yac, yrec = _luma_mb_batch(sy, pred_y, qp)
-            udc, uac, urec = _chroma_mb_batch(su, pred_u, qpc)
-            vdc, vac, vrec = _chroma_mb_batch(sv, pred_v, qpc)
-            carry = (yrec[:, -1, :].reshape(-1), urec[:, -1, :].reshape(-1),
+            sy, su, sv, qp_r, qpc_r = x                          # (mbw,...)
+            pred_vy = jnp.broadcast_to(by.reshape(mbw, 1, 16),
+                                       (mbw, 16, 16))
+            pred_vu = jnp.broadcast_to(bu.reshape(mbw, 1, 8), (mbw, 8, 8))
+            pred_vv = jnp.broadcast_to(bv.reshape(mbw, 1, 8), (mbw, 8, 8))
+            qp_v = qp_r if rd.aq_q else qp
+            qpc_v = qpc_r if rd.aq_q else qpc
+            if not rd.mode_decision:
+                ydc, yac, yrec = _luma_mb_batch(sy, pred_vy, qp_v)
+                udc, uac, urec = _chroma_mb_batch(su, pred_vu, qpc_v)
+                vdc, vac, vrec = _chroma_mb_batch(sv, pred_vv, qpc_v)
+                ymode = jnp.zeros(mbw, jnp.int32) + zero
+                cmode = jnp.full(mbw, 2, jnp.int32) + zero
+                carry = (yrec[:, -1, :].reshape(-1),
+                         urec[:, -1, :].reshape(-1),
+                         vrec[:, -1, :].reshape(-1))
+                return carry, (ydc, yac, udc, uac, vdc, vac,
+                               yrec, urec, vrec, ymode, cmode)
+
+            # stage 1: vertical encode of the whole row (candidate
+            # recon for the neighbors' H/DC predictions)
+            _, _, yrecv = _luma_mb_batch(sy, pred_vy, qp_v)
+            _, _, urecv = _chroma_mb_batch(su, pred_vu, qpc_v)
+            _, _, vrecv = _chroma_mb_batch(sv, pred_vv, qpc_v)
+
+            # stage 2: candidate costs. Left columns come from the
+            # LEFT neighbor's stage-1 (vertical) recon — exact for
+            # every switched MB because the greedy constraint keeps
+            # its left neighbor vertical.
+            lcol_y = jnp.concatenate(
+                [jnp.zeros((1, 16), jnp.int32), yrecv[:-1, :, -1]])
+            lcol_u = jnp.concatenate(
+                [jnp.zeros((1, 8), jnp.int32), urecv[:-1, :, -1]])
+            lcol_v = jnp.concatenate(
+                [jnp.zeros((1, 8), jnp.int32), vrecv[:-1, :, -1]])
+            has_left = (jnp.arange(mbw) > 0)
+            pred_hy = jnp.broadcast_to(lcol_y[:, :, None], (mbw, 16, 16))
+            pred_hu = jnp.broadcast_to(lcol_u[:, :, None], (mbw, 8, 8))
+            pred_hv = jnp.broadcast_to(lcol_v[:, :, None], (mbw, 8, 8))
+            tsum_y = by.reshape(mbw, 16).sum(axis=1)
+            lsum_y = lcol_y.sum(axis=1)
+            dc_y = jnp.where(has_left,
+                             (tsum_y + lsum_y + 16) >> 5,
+                             (tsum_y + 8) >> 4)
+            pred_dcy = jnp.broadcast_to(dc_y[:, None, None], (mbw, 16, 16))
+            ts_u = bu.reshape(mbw, 2, 4).sum(axis=2)     # (mbw, 2)
+            ts_v = bv.reshape(mbw, 2, 4).sum(axis=2)
+            ls_u = lcol_u.reshape(mbw, 2, 4).sum(axis=2)
+            ls_v = lcol_v.reshape(mbw, 2, 4).sum(axis=2)
+            avail_top = jnp.ones(mbw, bool)
+            pred_dcu = _chroma_dc_pred_row(ts_u, ls_u, has_left, avail_top)
+            pred_dcv = _chroma_dc_pred_row(ts_v, ls_v, has_left, avail_top)
+
+            c_v = _satd16(sy - pred_vy)
+            c_h = jnp.where(has_left, _satd16(sy - pred_hy), _COST_INF)
+            c_dc = _satd16(sy - pred_dcy)
+            cc_v = _satd8(su - pred_vu) + _satd8(sv - pred_vv)
+            cc_h = jnp.where(has_left,
+                             _satd8(su - pred_hu) + _satd8(sv - pred_hv),
+                             _COST_INF)
+            cc_dc = _satd8(su - pred_dcu) + _satd8(sv - pred_dcv)
+
+            best_y, ymode_alt = _pick3(c_v, 0, c_h, 1, c_dc, 2)
+            best_c, cmode_alt = _pick3(cc_v, 2, cc_h, 1, cc_dc, 0)
+            desired = (best_y + best_c) < (c_v + cc_v)
+            allowed = _greedy_allowed(desired)
+
+            ymode = jnp.where(allowed, ymode_alt, 0)
+            cmode = jnp.where(allowed, cmode_alt, 2)
+            pred_y = jnp.where((ymode == 0)[:, None, None], pred_vy,
+                               jnp.where((ymode == 1)[:, None, None],
+                                         pred_hy, pred_dcy))
+            pred_u = jnp.where((cmode == 2)[:, None, None], pred_vu,
+                               jnp.where((cmode == 1)[:, None, None],
+                                         pred_hu, pred_dcu))
+            pred_v = jnp.where((cmode == 2)[:, None, None], pred_vv,
+                               jnp.where((cmode == 1)[:, None, None],
+                                         pred_hv, pred_dcv))
+
+            ydc, yac, yrec = _luma_mb_batch(sy, pred_y, qp_v)
+            udc, uac, urec = _chroma_mb_batch(su, pred_u, qpc_v)
+            vdc, vac, vrec = _chroma_mb_batch(sv, pred_v, qpc_v)
+            carry = (yrec[:, -1, :].reshape(-1),
+                     urec[:, -1, :].reshape(-1),
                      vrec[:, -1, :].reshape(-1))
-            return carry, (ydc, yac, udc, uac, vdc, vac, yrec, urec, vrec)
+            return carry, (ydc, yac, udc, uac, vdc, vac,
+                           yrec, urec, vrec, ymode, cmode)
 
         _, rows_out = jax.lax.scan(
-            row_step, (bottom_y, bottom_u, bottom_v), (y_rows, u_rows, v_rows))
+            row_step, (bottom_y, bottom_u, bottom_v),
+            (y_rows, u_rows, v_rows, qp_rows[1:], qpc_rows[1:]))
         (ydc_r, yac_r, udc_r, uac_r, vdc_r, vac_r,
-         yrec_r, urec_r, vrec_r) = rows_out
+         yrec_r, urec_r, vrec_r, ymode_r, cmode_r) = rows_out
         luma_dc = jnp.concatenate([r0_ydc[None], ydc_r]).reshape(-1, 16)
         luma_ac = jnp.concatenate([r0_yac[None], yac_r]).reshape(-1, 16, 15)
         u_dc = jnp.concatenate([r0_udc[None], udc_r]).reshape(-1, 4)
@@ -246,12 +527,16 @@ def _intra_core(y, u, v, qp, *, mbw: int, mbh: int):
         yrec_all = jnp.concatenate([r0_yrec[None], yrec_r])  # (mbh,mbw,16,16)
         urec_all = jnp.concatenate([r0_urec[None], urec_r])
         vrec_all = jnp.concatenate([r0_vrec[None], vrec_r])
+        luma_mode = jnp.concatenate([r0_ymode[None], ymode_r]).reshape(-1)
+        chroma_mode = jnp.concatenate([r0_cmode[None], cmode_r]).reshape(-1)
     else:
         luma_dc, luma_ac = r0_ydc, r0_yac
         u_dc, u_ac, v_dc, v_ac = r0_udc, r0_uac, r0_vdc, r0_vac
         yrec_all = r0_yrec[None]
         urec_all = r0_urec[None]
         vrec_all = r0_vrec[None]
+        luma_mode = r0_ymode.reshape(-1)
+        chroma_mode = r0_cmode.reshape(-1)
 
     chroma_dc = jnp.stack([u_dc, v_dc], axis=1)                  # (nmb,2,4)
     chroma_ac = jnp.stack([u_ac, v_ac], axis=1)                  # (nmb,2,4,15)
@@ -259,21 +544,43 @@ def _intra_core(y, u, v, qp, *, mbw: int, mbh: int):
     recon_u = urec_all.transpose(0, 2, 1, 3).reshape(8 * mbh, 8 * mbw)
     recon_v = vrec_all.transpose(0, 2, 1, 3).reshape(8 * mbh, 8 * mbw)
     return (luma_dc, luma_ac, chroma_dc, chroma_ac,
-            recon_y, recon_u, recon_v)
+            recon_y, recon_u, recon_v,
+            luma_mode.astype(jnp.int32), chroma_mode.astype(jnp.int32),
+            qp_delta)
 
 
-@functools.partial(jax.jit, static_argnames=("mbw", "mbh", "dtype"))
-def _encode_intra_packed(y, u, v, qp, *, mbw: int, mbh: int, dtype):
+def _mode_tail(luma_mode, chroma_mode, qp_delta):
+    """The per-MB side channel appended to intra transfer vectors when
+    rd.ships_modes: [mode16 | dqp16], mode16 = luma | chroma << 4."""
+    return jnp.concatenate([
+        (luma_mode | (chroma_mode << 4)).astype(jnp.int16),
+        qp_delta.astype(jnp.int16)])
+
+
+@functools.partial(jax.jit, static_argnames=("mbw", "mbh", "dtype", "rd"))
+def _encode_intra_packed(y, u, v, qp, *, mbw: int, mbh: int, dtype,
+                         rd=RD_OFF):
     """Dense fallback: intra compute + device-side concat of all level
     arrays into ONE flat `dtype` buffer (int16 covers the full CAVLC
     level range at 2x fewer device→host bytes than raw int32). The
-    common path is the sparse transfer (`_encode_intra_sparse`)."""
-    luma_dc, luma_ac, chroma_dc, chroma_ac = _encode_intra(
-        y, u, v, qp, mbw=mbw, mbh=mbh)
-    flat = jnp.concatenate([
-        luma_dc.reshape(-1), luma_ac.reshape(-1),
-        chroma_dc.reshape(-1), chroma_ac.reshape(-1)])
-    return flat.astype(dtype)
+    common path is the sparse transfer (`_encode_intra_sparse`). With
+    rd.ships_modes the per-MB [mode16 | dqp16] side channel rides at
+    the tail (see intra_flat_len)."""
+    out = _intra_core(y, u, v, qp, mbw=mbw, mbh=mbh, rd=rd)
+    luma_dc, luma_ac, chroma_dc, chroma_ac = out[:4]
+    parts = [luma_dc.reshape(-1), luma_ac.reshape(-1),
+             chroma_dc.reshape(-1), chroma_ac.reshape(-1)]
+    flat = jnp.concatenate(parts).astype(dtype)
+    if rd.ships_modes:
+        flat = jnp.concatenate([flat,
+                                _mode_tail(out[7], out[8], out[9])
+                                .astype(dtype)])
+    return flat
+
+
+def intra_flat_len(nmb: int, rd=RD_OFF) -> int:
+    """Length of one frame's flat intra transfer vector."""
+    return nmb * 384 + (2 * nmb if rd.ships_modes else 0)
 
 
 _I8_MAX = 127
@@ -570,24 +877,34 @@ def _sparse_unpack(nnz: int, n_esc: int, bitmap: np.ndarray,
     return out
 
 
-@functools.partial(jax.jit, static_argnames=("mbw", "mbh"))
-def _encode_intra_sparse(y, u, v, qp, *, mbw: int, mbh: int):
-    luma_dc, luma_ac, chroma_dc, chroma_ac = _encode_intra(
-        y, u, v, qp, mbw=mbw, mbh=mbh)
-    flat = jnp.concatenate([
-        luma_dc.reshape(-1), luma_ac.reshape(-1),
-        chroma_dc.reshape(-1), chroma_ac.reshape(-1)])
-    return _sparse_pack(flat)
+@functools.partial(jax.jit, static_argnames=("mbw", "mbh", "rd"))
+def _encode_intra_sparse(y, u, v, qp, *, mbw: int, mbh: int, rd=RD_OFF):
+    out = _intra_core(y, u, v, qp, mbw=mbw, mbh=mbh, rd=rd)
+    luma_dc, luma_ac, chroma_dc, chroma_ac = out[:4]
+    parts = [luma_dc.reshape(-1), luma_ac.reshape(-1),
+             chroma_dc.reshape(-1), chroma_ac.reshape(-1)]
+    if rd.ships_modes:
+        parts.append(_mode_tail(out[7], out[8], out[9]).astype(jnp.int32))
+    return _sparse_pack(jnp.concatenate(parts))
 
 
-def _unpack_levels(flat: np.ndarray, mbw: int, mbh: int) -> FrameLevels:
+def _unpack_levels(flat: np.ndarray, mbw: int, mbh: int,
+                   rd=RD_OFF) -> FrameLevels:
     nmb = mbw * mbh
     sizes = (nmb * 16, nmb * 16 * 15, nmb * 2 * 4, nmb * 2 * 4 * 15)
     offs = np.cumsum((0,) + sizes)
     # keep the transfer dtype: int16 feeds the zero-copy native entry
     # (cavlc_pack_islice16), int32 the original one — no widening here
     flat = np.asarray(flat)
-    luma_mode, chroma_mode = _mode_policy(mbw, mbh)
+    if rd.ships_modes:
+        mode16 = np.asarray(flat[offs[4]:offs[4] + nmb], np.int32)
+        luma_mode = mode16 & 15
+        chroma_mode = mode16 >> 4
+        qp_delta = np.asarray(flat[offs[4] + nmb:offs[4] + 2 * nmb],
+                              np.int32)
+    else:
+        luma_mode, chroma_mode = _mode_policy(mbw, mbh)
+        qp_delta = None
     return FrameLevels(
         luma_mode=luma_mode,
         chroma_mode=chroma_mode,
@@ -595,30 +912,31 @@ def _unpack_levels(flat: np.ndarray, mbw: int, mbh: int) -> FrameLevels:
         luma_ac=flat[offs[1]:offs[2]].reshape(nmb, 16, 15),
         chroma_dc=flat[offs[2]:offs[3]].reshape(nmb, 2, 4),
         chroma_ac=flat[offs[3]:offs[4]].reshape(nmb, 2, 4, 15),
+        qp_delta=qp_delta,
     )
 
 
 def encode_intra_jax(y: np.ndarray, u: np.ndarray, v: np.ndarray,
-                     qp: int) -> FrameLevels:
+                     qp: int, rd=RD_OFF) -> FrameLevels:
     """Run the jitted intra compute and return host-side FrameLevels."""
     mbh, mbw = y.shape[0] // 16, y.shape[1] // 16
     yd, ud, vd = jnp.asarray(y), jnp.asarray(u), jnp.asarray(v)
     qpd = jnp.asarray(qp)
-    L = mbw * mbh * 384
+    L = intra_flat_len(mbw * mbh, rd)
     nnz, n_esc, bitmap, vals, esc_pos, esc_val = jax.device_get(
-        _encode_intra_sparse(yd, ud, vd, qpd, mbw=mbw, mbh=mbh))
+        _encode_intra_sparse(yd, ud, vd, qpd, mbw=mbw, mbh=mbh, rd=rd))
     if sparse_fits(nnz, n_esc, L):
         return _unpack_levels(
             _sparse_unpack(int(nnz), int(n_esc), bitmap, vals,
-                           esc_pos, esc_val, L), mbw, mbh)
+                           esc_pos, esc_val, L), mbw, mbh, rd)
     # Rare (very dense content): recompute (cheap) and fetch wide.
     flat16 = _encode_intra_packed(yd, ud, vd, qpd, mbw=mbw, mbh=mbh,
-                                  dtype=jnp.int16)
-    return _unpack_levels(np.asarray(flat16), mbw, mbh)
+                                  dtype=jnp.int16, rd=rd)
+    return _unpack_levels(np.asarray(flat16), mbw, mbh, rd)
 
 
-def build_intra_encoder(y_shape: tuple[int, int], qp: int):
+def build_intra_encoder(y_shape: tuple[int, int], qp: int, rd=RD_OFF):
     """Encoder-facing factory: returns fn(y, u, v) -> FrameLevels."""
     def fn(y, u, v):
-        return encode_intra_jax(y, u, v, qp)
+        return encode_intra_jax(y, u, v, qp, rd)
     return fn

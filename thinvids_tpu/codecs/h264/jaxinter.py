@@ -37,9 +37,11 @@ from .jaxcore import (
     _ZZ,
     _ZSCAN,
     _intra_core,
+    _mode_tail,
     _varying_zero,
 )
-from . import jaxme
+from . import jaxdeblock, jaxme, rdo
+from .rdo import RD_OFF
 
 SEARCH_RANGE = jaxme.SEARCH_RANGE      # integer-pel, each direction
 
@@ -161,7 +163,7 @@ def _dc_pos_expand(dcr_grid, h, wd_):
 
 
 def _encode_p_plane(cy, cu, cv, ry, ru, rv, pred_mv, qp, qpc, *, mbw: int,
-                    mbh: int, blocked: bool = True):
+                    mbh: int, blocked: bool = True, rd=RD_OFF):
     """One P frame given previous recon planes (int16). `pred_mv` is the
     previous frame's median MV in half-pel units (a search center).
 
@@ -181,20 +183,35 @@ def _encode_p_plane(cy, cu, cv, ry, ru, rv, pred_mv, qp, qpc, *, mbw: int,
     mv, pred_y, pred_u, pred_v, med_mv = jaxme.me_search(
         cy16, ry, ru, rv, pred_mv, qp.astype(jnp.int32))
 
-    (luma_levels, chroma_dc, chroma_ac, recon_y, recon_u, recon_v) = \
-        _residual_p(cy16, cu16, cv16, pred_y, pred_u, pred_v, qp, qpc,
-                    mbw=mbw, mbh=mbh, blocked=blocked)
+    (luma_levels, chroma_dc, chroma_ac, recon_y, recon_u, recon_v,
+     nz4) = _residual_p(cy16, cu16, cv16, pred_y, pred_u, pred_v, qp,
+                        qpc, mbw=mbw, mbh=mbh, blocked=blocked, rd=rd)
+    if rd.deblock:
+        qp_map = jnp.broadcast_to(qp.astype(jnp.int32), (mbh, mbw))
+        recon_y, recon_u, recon_v = jaxdeblock.deblock_frame_jax(
+            recon_y, recon_u, recon_v, qp_map, intra=False, nz4=nz4,
+            mv=mv)
     return (mv.reshape(n, 2), luma_levels, chroma_dc, chroma_ac,
             recon_y, recon_u, recon_v, med_mv)
 
 
 def _residual_p(cy16, cu16, cv16, pred_y, pred_u, pred_v, qp, qpc, *,
-                mbw: int, mbh: int, blocked: bool = True):
+                mbw: int, mbh: int, blocked: bool = True, rd=RD_OFF):
     """Residual transform/quant/recon for one P frame given its
     prediction planes — the motion-search-free half of
     :func:`_encode_p_plane`, split out so the banded (SFE) path can
     pair it with `jaxme.me_search_banded`. Per-MB local math only: no
-    cross-MB (or cross-band) dependencies."""
+    cross-MB (or cross-band) dependencies.
+
+    With ``rd.pskip`` an MB whose quantized residual is negligible
+    (sum |level| <= rdo.PSKIP_SUM across all planes, every |level| <=
+    1) drops the residual entirely: its recon becomes pure prediction
+    — exactly what a decoder reconstructs for a P_Skip MB — and the
+    entropy packer's §8.4.1.1 inference turns it into a skip run
+    whenever its MV matches the skip predictor.
+
+    Also returns nz4, the (4·mbh, 4·mbw) any-nonzero map of the FINAL
+    luma levels (the deblocking filter's bS=2 input)."""
     H, W = cy16.shape
     n = mbw * mbh
     qp32 = qp.astype(jnp.int32)
@@ -203,21 +220,12 @@ def _residual_p(cy16, cu16, cv16, pred_y, pred_u, pred_v, qp, qpc, *,
     mf_c = _tile_plane(_MF[qpc % 6], H // 2, W // 2)
     v_c = _tile_plane(_V[qpc % 6], H // 2, W // 2)
 
-    # --- luma: 16 standalone 4x4 transforms per MB (no DC split) ---
+    # --- quantize: luma plane + both chroma planes -------------------
     resid = (cy16 - pred_y).astype(jnp.int32)
     w = _fwd4_plane(resid)
     z = _quant_plane(w, mf_y, qp32)
-    d = _dequant_plane(z, v_y, qp32)
-    recon_y = jnp.clip((_inv4_plane(d) + 32 >> 6) + pred_y, 0, 255
-                       ).astype(jnp.int16)
-    if blocked:
-        luma_levels = _luma_plane_to_blocks(z.astype(jnp.int16), mbw, mbh
-                                            ).astype(jnp.int32)
-    else:
-        luma_levels = z.astype(jnp.int16)               # (H, W) coeff plane
 
-    # --- chroma: AC plane + 2x2 hadamard DC per MB ---
-    def chroma(cplane16, pred, mf_c, v_c):
+    def chroma_quant(cplane16, pred):
         h, wd_ = cplane16.shape
         resid = (cplane16 - pred).astype(jnp.int32)
         wch = _fwd4_plane(resid)
@@ -236,6 +244,48 @@ def _residual_p(cy16, cu16, cv16, pred_y, pred_u, pred_v, qp, qpc, *,
         zdc = jnp.where(wd2 < 0, -zdc, zdc)              # (mbh, mbw, 4)
         # AC quant with DC positions zeroed
         zac = _quant_plane(wch, mf_c, qpc) * _dc_mask(h, wd_)
+        return zdc, zac
+
+    u_zdc, u_zac = chroma_quant(cu16, pred_u)
+    v_zdc, v_zac = chroma_quant(cv16, pred_v)
+
+    if rd.pskip:
+        # P_Skip bias: per-MB level mass across every plane
+        zb = z.reshape(mbh, 16, mbw, 16)
+        az = jnp.abs(zb)
+        def cmass(zac):
+            c = jnp.abs(zac.reshape(mbh, 8, mbw, 8))
+            return c.sum(axis=(1, 3)), c.max(axis=(1, 3))
+        us, umx = cmass(u_zac)
+        vs, vmx = cmass(v_zac)
+        mb_sum = (az.sum(axis=(1, 3)) + us + vs
+                  + jnp.abs(u_zdc).sum(axis=-1) + jnp.abs(v_zdc).sum(-1))
+        mb_max = jnp.maximum(
+            jnp.maximum(az.max(axis=(1, 3)), jnp.maximum(umx, vmx)),
+            jnp.maximum(jnp.abs(u_zdc).max(-1), jnp.abs(v_zdc).max(-1)))
+        drop = (mb_sum <= rdo.PSKIP_SUM) & (mb_max <= 1)   # (mbh, mbw)
+        keep_y = ~jnp.repeat(jnp.repeat(drop, 16, 0), 16, 1)
+        keep_c = ~jnp.repeat(jnp.repeat(drop, 8, 0), 8, 1)
+        z = jnp.where(keep_y.reshape(H, W), z, 0)
+        u_zac = jnp.where(keep_c, u_zac, 0)
+        v_zac = jnp.where(keep_c, v_zac, 0)
+        u_zdc = jnp.where(drop[..., None], 0, u_zdc)
+        v_zdc = jnp.where(drop[..., None], 0, v_zdc)
+
+    nz4 = jaxdeblock.nz4_from_luma_plane(z, mbh, mbw)
+
+    # --- reconstruct from the (possibly zeroed) levels ---------------
+    d = _dequant_plane(z, v_y, qp32)
+    recon_y = jnp.clip((_inv4_plane(d) + 32 >> 6) + pred_y, 0, 255
+                       ).astype(jnp.int16)
+    if blocked:
+        luma_levels = _luma_plane_to_blocks(z.astype(jnp.int16), mbw, mbh
+                                            ).astype(jnp.int32)
+    else:
+        luma_levels = z.astype(jnp.int16)               # (H, W) coeff plane
+
+    def chroma_recon(pred, zdc, zac):
+        h, wd_ = pred.shape
         # recon: dequant AC, reinsert dequantized DC, inverse
         dac = _dequant_plane(zac, v_c, qpc)
         z00, z01 = zdc[..., 0], zdc[..., 1]
@@ -262,8 +312,8 @@ def _residual_p(cy16, cu16, cv16, pred_y, pred_u, pred_v, qp, qpc, *,
         dc_lev = zdc.reshape(n, 4)
         return dc_lev, ac, rec
 
-    udc, uac, recon_u = chroma(cu16, pred_u, mf_c, v_c)
-    vdc, vac, recon_v = chroma(cv16, pred_v, mf_c, v_c)
+    udc, uac, recon_u = chroma_recon(pred_u, u_zdc, u_zac)
+    vdc, vac, recon_v = chroma_recon(pred_v, v_zdc, v_zac)
     if blocked:
         chroma_dc = jnp.stack([udc, vdc], axis=1)        # (n, 2, 4)
         chroma_ac = jnp.stack([uac, vac], axis=1)        # (n, 2, 4, 15)
@@ -271,32 +321,59 @@ def _residual_p(cy16, cu16, cv16, pred_y, pred_u, pred_v, qp, qpc, *,
         chroma_dc = jnp.stack([udc, vdc]).astype(jnp.int16)  # (2, n, 4)
         chroma_ac = jnp.stack([uac, vac])                # (2, H/2, W/2)
 
-    return (luma_levels, chroma_dc, chroma_ac, recon_y, recon_u, recon_v)
+    return (luma_levels, chroma_dc, chroma_ac, recon_y, recon_u, recon_v,
+            nz4)
 
 
-@functools.partial(jax.jit, static_argnames=("mbw", "mbh", "emit_recon"))
-def encode_gop_jit(ys, us, vs, qp, *, mbw: int, mbh: int,
-                   emit_recon: bool = False):
-    """Closed-GOP compute: frame 0 intra, frames 1..F-1 inter (P).
-
-    ys: (F, H, W) uint8. Returns the intra frame's level arrays plus the
-    P frames' (mv, luma16, chroma_dc, chroma_ac) stacked over F-1; with
-    `emit_recon` also the per-frame reconstructed planes (tests/metrics —
-    costs F x frame HBM, off by default).
-    """
-    qp = qp.astype(jnp.int32)
-    qpc = _QPC[jnp.clip(qp, 0, 51)]
-    (il_dc, il_ac, ic_dc, ic_ac, ry, ru, rv) = _intra_core(
-        ys[0], us[0], vs[0], qp, mbw=mbw, mbh=mbh)
+def _intra_frame_outputs(y, u, v, qp, *, mbw: int, mbh: int, rd):
+    """Shared IDR half of the GOP programs: intra core + (optionally)
+    deblocked recon carry + the pack-facing intra tuple (4 blocked
+    arrays, or 6 with the per-MB [mode16 | dqp16] side channel when
+    rd.ships_modes)."""
+    out = _intra_core(y, u, v, qp, mbw=mbw, mbh=mbh, rd=rd)
+    il_dc, il_ac, ic_dc, ic_ac, ry, ru, rv = out[:7]
+    luma_mode, chroma_mode, qp_delta = out[7:]
     ry = ry.astype(jnp.int16)
     ru = ru.astype(jnp.int16)
     rv = rv.astype(jnp.int16)
+    if rd.deblock:
+        qp_map = (qp.astype(jnp.int32) + qp_delta).reshape(mbh, mbw)
+        ry, ru, rv = jaxdeblock.deblock_frame_jax(
+            ry, ru, rv, qp_map, intra=True)
+    if rd.ships_modes:
+        tail = _mode_tail(luma_mode, chroma_mode, qp_delta)
+        intra = (il_dc, il_ac, ic_dc, ic_ac,
+                 tail[:mbw * mbh], tail[mbw * mbh:])
+    else:
+        intra = (il_dc, il_ac, ic_dc, ic_ac)
+    return intra, (ry, ru, rv)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("mbw", "mbh", "emit_recon", "rd"))
+def encode_gop_jit(ys, us, vs, qp, *, mbw: int, mbh: int,
+                   emit_recon: bool = False, rd=RD_OFF):
+    """Closed-GOP compute: frame 0 intra, frames 1..F-1 inter (P).
+
+    ys: (F, H, W) uint8. Returns the intra frame's level arrays (plus
+    the mode/dqp side channel when rd.ships_modes) and the P frames'
+    (mv, luma16, chroma_dc, chroma_ac) stacked over F-1; with
+    `emit_recon` also the per-frame reconstructed planes (tests/metrics
+    — costs F x frame HBM, off by default). With rd.deblock the recon
+    chained between frames (and emitted) is the §8.7-filtered plane —
+    exactly what a conformant decoder holds.
+    """
+    qp = qp.astype(jnp.int32)
+    qpc = _QPC[jnp.clip(qp, 0, 51)]
+    intra, (ry, ru, rv) = _intra_frame_outputs(
+        ys[0], us[0], vs[0], qp, mbw=mbw, mbh=mbh, rd=rd)
 
     def p_step(carry, xs):
         ry, ru, rv, pred_mv = carry
         cy, cu, cv = xs
         (mv, l16, cdc, cac, ry2, ru2, rv2, med_mv) = _encode_p_plane(
-            cy, cu, cv, ry, ru, rv, pred_mv, qp, qpc, mbw=mbw, mbh=mbh)
+            cy, cu, cv, ry, ru, rv, pred_mv, qp, qpc, mbw=mbw, mbh=mbh,
+            rd=rd)
         outs = (mv, l16, cdc, cac)
         if emit_recon:
             outs = outs + (ry2, ru2, rv2)
@@ -308,7 +385,6 @@ def encode_gop_jit(ys, us, vs, qp, *, mbw: int, mbh: int,
     zero_mv = jnp.zeros(2, jnp.int32) + zero
     _, pouts = jax.lax.scan(
         p_step, (ry, ru, rv, zero_mv), (ys[1:], us[1:], vs[1:]))
-    intra = (il_dc, il_ac, ic_dc, ic_ac)
     if emit_recon:
         mv, l16, cdc, cac, pry, pru, prv = pouts
         recon_y = jnp.concatenate([ry[None], pry]).astype(jnp.int32)
@@ -329,7 +405,7 @@ def encode_gop_jit(ys, us, vs, qp, *, mbw: int, mbh: int,
 from .layout import _INTRA_FLAT_MB, _P_FLAT_MB  # noqa: E402
 
 
-def encode_gop_planes(ys, us, vs, qp, *, mbw: int, mbh: int):
+def encode_gop_planes(ys, us, vs, qp, *, mbw: int, mbh: int, rd=RD_OFF):
     """Closed-GOP compute emitting PLANE-layout levels for the sharded
     transfer path: returns (mv (F-1, nmb, 2) int8, flat int16).
 
@@ -337,7 +413,8 @@ def encode_gop_planes(ys, us, vs, qp, *, mbw: int, mbh: int):
       [ intra il_dc | il_ac | ic_dc | ic_ac          (nmb * 384)
       | luma coeff planes   (F-1, H, W)
       | u DC (F-1, nmb, 4) | v DC (F-1, nmb, 4)
-      | u AC plane (F-1, H/2, W/2) | v AC plane (F-1, H/2, W/2) ]
+      | u AC plane (F-1, H/2, W/2) | v AC plane (F-1, H/2, W/2)
+      | intra mode16 (nmb) | intra dqp16 (nmb)   — rd.ships_modes only ]
 
     The host inverse is parallel/dispatch._unflatten_gop.
     """
@@ -350,18 +427,15 @@ def encode_gop_planes(ys, us, vs, qp, *, mbw: int, mbh: int):
         raise ValueError("SEARCH_RANGE exceeds the int8 MV transfer")
     qp = qp.astype(jnp.int32)
     qpc = _QPC[jnp.clip(qp, 0, 51)]
-    (il_dc, il_ac, ic_dc, ic_ac, ry, ru, rv) = _intra_core(
-        ys[0], us[0], vs[0], qp, mbw=mbw, mbh=mbh)
-    ry = ry.astype(jnp.int16)
-    ru = ru.astype(jnp.int16)
-    rv = rv.astype(jnp.int16)
+    intra, (ry, ru, rv) = _intra_frame_outputs(
+        ys[0], us[0], vs[0], qp, mbw=mbw, mbh=mbh, rd=rd)
 
     def p_step(carry, xs):
         ry, ru, rv, pred_mv = carry
         cy, cu, cv = xs
         (mv, lp, cdc, cac, ry2, ru2, rv2, med_mv) = _encode_p_plane(
             cy, cu, cv, ry, ru, rv, pred_mv, qp, qpc, mbw=mbw, mbh=mbh,
-            blocked=False)
+            blocked=False, rd=rd)
         return (ry2, ru2, rv2, med_mv), (mv.astype(jnp.int8), lp, cdc, cac)
 
     zero = _varying_zero(ry)
@@ -369,16 +443,18 @@ def encode_gop_planes(ys, us, vs, qp, *, mbw: int, mbh: int):
     _, (mv8, lps, cdcs, cacs) = jax.lax.scan(
         p_step, (ry, ru, rv, zero_mv), (ys[1:], us[1:], vs[1:]))
     # cdcs: (F-1, 2, n, 4) int16; cacs: (F-1, 2, H/2, W/2) int16
-    flat = jnp.concatenate([
-        il_dc.reshape(-1).astype(jnp.int16),
-        il_ac.reshape(-1).astype(jnp.int16),
-        ic_dc.reshape(-1).astype(jnp.int16),
-        ic_ac.reshape(-1).astype(jnp.int16),
+    parts = [
+        intra[0].reshape(-1).astype(jnp.int16),
+        intra[1].reshape(-1).astype(jnp.int16),
+        intra[2].reshape(-1).astype(jnp.int16),
+        intra[3].reshape(-1).astype(jnp.int16),
         lps.reshape(-1),
         cdcs[:, 0].reshape(-1), cdcs[:, 1].reshape(-1),
         cacs[:, 0].reshape(-1), cacs[:, 1].reshape(-1),
-    ])
-    return mv8, flat
+    ]
+    if rd.ships_modes:
+        parts.extend([intra[4], intra[5]])
+    return mv8, jnp.concatenate(parts)
 
 
 # ---------------------------------------------------------------------------
@@ -391,6 +467,46 @@ def encode_gop_planes(ys, us, vs, qp, *, mbw: int, mbh: int):
 # core runs on one band's (Hb, W) shard under shard_map; the recon
 # carry chains between steps ON DEVICE.
 # ---------------------------------------------------------------------------
+
+
+def _deblock_band(ry, ru, rv, qp, *, intra: bool, nz4, mv, mbw: int,
+                  mbh_band: int, total_mb_rows: int, axis_name,
+                  num_bands: int):
+    """Deblock one band's recon with a ONE-MB-ROW cross-band halo.
+
+    The §8.7 filter's vertical passes are row-local, and its horizontal
+    passes read/write at most 4 rows across an MB edge — so exchanging
+    16 raw recon rows (plus the neighbor MB row's bS metadata: nz map
+    and MVs; QP is flat in SFE) and running the full shifted-plane
+    schedule on the extended planes reproduces the FULL-FRAME filter
+    exactly: halo rows V-filter to the same values the neighbor band
+    computes for its own rows, the boundary H edge is computed
+    identically on both sides, and the per-band slices back out
+    byte-identical to the unbanded program (tested across band
+    counts). Frame edges / the last band's padding rows are masked via
+    the global (mb_row0, total_mb_rows) coordinates, with mb_row0
+    traced (lax.axis_index) so one program serves every band."""
+    banded = axis_name is not None and num_bands > 1
+    exch = functools.partial(jaxme.band_halo_exchange,
+                             axis_name=axis_name, num_bands=num_bands)
+    ry_e = exch(ry, 16)
+    ru_e = exch(ru, 8)
+    rv_e = exch(rv, 8)
+    idx = jax.lax.axis_index(axis_name) if banded \
+        else jnp.int32(0) + _varying_zero(ry)
+    mb_row0 = idx * mbh_band - 1          # extended plane: 1 MB row above
+    qp_map = jnp.broadcast_to(qp.astype(jnp.int32),
+                              (mbh_band + 2, mbw))
+    nz_e = mv_e = None
+    if not intra:
+        nz_e = exch(nz4.astype(jnp.int16), 4) != 0
+        mv_e = exch(mv.reshape(mbh_band, 2 * mbw), 1) \
+            .reshape(mbh_band + 2, mbw, 2)
+    y2, u2, v2 = jaxdeblock.deblock_frame_jax(
+        ry_e, ru_e, rv_e, qp_map, intra=intra, nz4=nz_e, mv=mv_e,
+        mb_row0=mb_row0, total_mb_rows=total_mb_rows)
+    return (y2[16:16 + 16 * mbh_band], u2[8:8 + 8 * mbh_band],
+            v2[8:8 + 8 * mbh_band])
 
 
 def _fixup_band_recon(plane, real_rows, scale: int = 1):
@@ -407,57 +523,92 @@ def _fixup_band_recon(plane, real_rows, scale: int = 1):
     return jnp.take(plane, jnp.minimum(rows, real - 1), axis=0)
 
 
-def sfe_intra_band(y, u, v, qp, real_rows, *, mbw: int, mbh_band: int):
+def _sfe_intra_common(y, u, v, qp, real_rows, *, mbw: int,
+                      mbh_band: int, rd, total_mb_rows: int,
+                      axis_name, num_bands: int):
+    """Shared intra-band compute: slice-local core + recon fixup +
+    (with rd.deblock) the cross-band-halo in-loop filter on the carry.
+    Returns (core outputs, (ry, ru, rv, zero_mv))."""
+    out = _intra_core(y, u, v, qp, mbw=mbw, mbh=mbh_band, rd=rd)
+    ry = _fixup_band_recon(out[4].astype(jnp.int16), real_rows)
+    ru = _fixup_band_recon(out[5].astype(jnp.int16), real_rows, 2)
+    rv = _fixup_band_recon(out[6].astype(jnp.int16), real_rows, 2)
+    if rd.deblock:
+        # SFE runs AQ-free (enforced at encoder construction), so the
+        # band qp map is flat and no qp metadata crosses bands.
+        ry, ru, rv = _deblock_band(
+            ry, ru, rv, qp, intra=True, nz4=None, mv=None, mbw=mbw,
+            mbh_band=mbh_band, total_mb_rows=total_mb_rows,
+            axis_name=axis_name, num_bands=num_bands)
+        ry = _fixup_band_recon(ry, real_rows)
+        ru = _fixup_band_recon(ru, real_rows, 2)
+        rv = _fixup_band_recon(rv, real_rows, 2)
+    zero_mv = jnp.zeros(2, jnp.int32) + _varying_zero(ry)
+    return out, (ry, ru, rv, zero_mv)
+
+
+def sfe_intra_band(y, u, v, qp, real_rows, *, mbw: int, mbh_band: int,
+                   rd=RD_OFF, total_mb_rows: int = 0, axis_name=None,
+                   num_bands: int = 1):
     """One band's IDR step: slice-local intra prediction — the band's
     first MB row predicts like a frame's row 0 because the MBs above
     live in ANOTHER slice and are unavailable to intra prediction
     (§8.3: exactly what a conformant decoder reconstructs), so no
-    cross-band exchange is needed on intra frames.
+    cross-band exchange is needed on intra frames (the in-loop filter,
+    when enabled, is the one cross-band consumer — _deblock_band).
 
     Returns (dense, rest, (ry, ru, rv, pred_mv)): dense is the
     hadamard-DC prefix [il_dc | ic_dc] shipped uncompressed (the only
     levels that exceed int8 at practical QPs — same rationale as
-    dispatch._per_gop_sparse), rest is [il_ac | ic_ac] for the sparse
-    transfer, and the carry holds the fixed-up recon + a zero median
-    MV (each GOP's temporal predictor restarts at its IDR)."""
+    dispatch._per_gop_sparse) plus, when rd.ships_modes, the per-MB
+    [mode16 | dqp16] side channel; rest is [il_ac | ic_ac] for the
+    sparse transfer, and the carry holds the fixed-up recon + a zero
+    median MV (each GOP's temporal predictor restarts at its IDR)."""
     qp = qp.astype(jnp.int32)
-    (il_dc, il_ac, ic_dc, ic_ac, ry, ru, rv) = _intra_core(
-        y, u, v, qp, mbw=mbw, mbh=mbh_band)
-    ry = _fixup_band_recon(ry.astype(jnp.int16), real_rows)
-    ru = _fixup_band_recon(ru.astype(jnp.int16), real_rows, 2)
-    rv = _fixup_band_recon(rv.astype(jnp.int16), real_rows, 2)
-    dense = jnp.concatenate([il_dc.reshape(-1).astype(jnp.int16),
-                             ic_dc.reshape(-1).astype(jnp.int16)])
+    out, carry = _sfe_intra_common(
+        y, u, v, qp, real_rows, mbw=mbw, mbh_band=mbh_band, rd=rd,
+        total_mb_rows=total_mb_rows, axis_name=axis_name,
+        num_bands=num_bands)
+    il_dc, il_ac, ic_dc, ic_ac = out[:4]
+    dense_parts = [il_dc.reshape(-1).astype(jnp.int16),
+                   ic_dc.reshape(-1).astype(jnp.int16)]
+    if rd.ships_modes:
+        dense_parts.append(_mode_tail(out[7], out[8], out[9]))
+    dense = jnp.concatenate(dense_parts)
     rest = jnp.concatenate([il_ac.reshape(-1).astype(jnp.int16),
                             ic_ac.reshape(-1).astype(jnp.int16)])
-    zero_mv = jnp.zeros(2, jnp.int32) + _varying_zero(ry)
-    return dense, rest, (ry, ru, rv, zero_mv)
+    return dense, rest, carry
 
 
 def sfe_intra_band_dense(y, u, v, qp, real_rows, *, mbw: int,
-                         mbh_band: int):
+                         mbh_band: int, rd=RD_OFF,
+                         total_mb_rows: int = 0, axis_name=None,
+                         num_bands: int = 1):
     """Dense-transfer variant of :func:`sfe_intra_band`: one flat int16
     vector in the standard intra layout (layout.unflatten_intra's
-    inverse) — the escape fallback path."""
+    inverse, mode/dqp tail appended when rd.ships_modes) — the escape
+    fallback path."""
     qp = qp.astype(jnp.int32)
-    (il_dc, il_ac, ic_dc, ic_ac, ry, ru, rv) = _intra_core(
-        y, u, v, qp, mbw=mbw, mbh=mbh_band)
-    ry = _fixup_band_recon(ry.astype(jnp.int16), real_rows)
-    ru = _fixup_band_recon(ru.astype(jnp.int16), real_rows, 2)
-    rv = _fixup_band_recon(rv.astype(jnp.int16), real_rows, 2)
-    flat = jnp.concatenate([
+    out, carry = _sfe_intra_common(
+        y, u, v, qp, real_rows, mbw=mbw, mbh_band=mbh_band, rd=rd,
+        total_mb_rows=total_mb_rows, axis_name=axis_name,
+        num_bands=num_bands)
+    il_dc, il_ac, ic_dc, ic_ac = out[:4]
+    parts = [
         il_dc.reshape(-1).astype(jnp.int16),
         il_ac.reshape(-1).astype(jnp.int16),
         ic_dc.reshape(-1).astype(jnp.int16),
-        ic_ac.reshape(-1).astype(jnp.int16)])
-    zero_mv = jnp.zeros(2, jnp.int32) + _varying_zero(ry)
-    return flat, (ry, ru, rv, zero_mv)
+        ic_ac.reshape(-1).astype(jnp.int16)]
+    if rd.ships_modes:
+        parts.append(_mode_tail(out[7], out[8], out[9]))
+    return jnp.concatenate(parts), carry
 
 
 def sfe_p_band(y, u, v, carry, qp, real_rows, *, mbw: int, mbh_band: int,
                halo_rows: int, num_bands: int, axis_name, ext=None,
                edge_top: bool = True, edge_bot: bool = True, probe=None,
-               return_hist: bool = False):
+               return_hist: bool = False, rd=RD_OFF,
+               total_mb_rows: int = 0):
     """One band's P step: banded motion search (halo exchange + psum'd
     global centers/median, jaxme.me_search_banded) + the shared
     residual core, emitting PLANE-layout levels for the per-frame
@@ -477,6 +628,14 @@ def sfe_p_band(y, u, v, carry, qp, real_rows, *, mbw: int, mbh_band: int,
     `return_hist` the tail is (cnt, n, (ry, ru, rv, pred_mv))."""
     if 2 * SEARCH_RANGE > 127:
         raise ValueError("SEARCH_RANGE exceeds the int8 MV transfer")
+    if rd.deblock and (ext is not None or probe is not None
+                       or return_hist):
+        # Farm band slices exchange halos over the host relay once per
+        # frame; the in-loop filter would need a second (post-recon)
+        # relay round. The remote planner falls back to GOP-range
+        # shards for deblock-enabled jobs instead.
+        raise ValueError("deblock is not supported on cross-host band "
+                         "slices; use GOP sharding for this job")
     ry, ru, rv, pred_mv = carry
     qp32 = qp.astype(jnp.int32)
     qpc = _QPC[jnp.clip(qp32, 0, 51)]
@@ -492,12 +651,20 @@ def sfe_p_band(y, u, v, carry, qp, real_rows, *, mbw: int, mbh_band: int,
         mv, py, pu, pv, cnt, n = out
     else:
         mv, py, pu, pv, med = out
-    (lp, cdc, cac, ry2, ru2, rv2) = _residual_p(
+    (lp, cdc, cac, ry2, ru2, rv2, nz4) = _residual_p(
         cy16, cu16, cv16, py, pu, pv, qp32, qpc, mbw=mbw, mbh=mbh_band,
-        blocked=False)
+        blocked=False, rd=rd)
     ry2 = _fixup_band_recon(ry2, real_rows)
     ru2 = _fixup_band_recon(ru2, real_rows, 2)
     rv2 = _fixup_band_recon(rv2, real_rows, 2)
+    if rd.deblock:
+        ry2, ru2, rv2 = _deblock_band(
+            ry2, ru2, rv2, qp32, intra=False, nz4=nz4, mv=mv,
+            mbw=mbw, mbh_band=mbh_band, total_mb_rows=total_mb_rows,
+            axis_name=axis_name, num_bands=num_bands)
+        ry2 = _fixup_band_recon(ry2, real_rows)
+        ru2 = _fixup_band_recon(ru2, real_rows, 2)
+        rv2 = _fixup_band_recon(rv2, real_rows, 2)
     flat = jnp.concatenate([
         lp.reshape(-1),
         cdc[0].reshape(-1), cdc[1].reshape(-1),

@@ -186,34 +186,44 @@ def unflatten_p_planes(seg: np.ndarray, mv8: np.ndarray, num_frames: int,
 
 
 def unflatten_gop(flat: np.ndarray, mv8: np.ndarray, num_frames: int,
-                  mbw: int, mbh: int):
+                  mbw: int, mbh: int, ships_modes: bool = False):
     """Host inverse of jaxinter.encode_gop_planes: split the flat int16
     vector into (intra blocked arrays, P plane views). EVERY array is a
-    zero-copy view into `flat`."""
+    zero-copy view into `flat`. With `ships_modes` the vector ends in
+    the per-MB intra [mode16 | dqp16] side channel, appended to the
+    returned intra tuple."""
     nmb = mbw * mbh
     flat = np.asarray(flat)
     o = nmb * _INTRA_FLAT_MB
     intra = unflatten_intra(flat[:o], nmb)
-    planes = unflatten_p_planes(flat[o:], mv8, num_frames, mbw, mbh)
+    p_end = flat.shape[0] - (2 * nmb if ships_modes else 0)
+    planes = unflatten_p_planes(flat[o:p_end], mv8, num_frames, mbw, mbh)
+    if ships_modes:
+        intra = intra + (flat[p_end:p_end + nmb], flat[p_end + nmb:])
     return intra, planes
 
 
 def unflatten_gop_parts(dense: np.ndarray, rest: np.ndarray,
                         mv8: np.ndarray, num_frames: int,
-                        mbw: int, mbh: int):
+                        mbw: int, mbh: int, ships_modes: bool = False):
     """Sparse-path unflatten straight from the two transfer segments —
-    dense = [il_dc | ic_dc] (the hadamard DC prefix, _per_gop_sparse),
-    rest = [il_ac | ic_ac | P planes] — without first concatenating
-    them back into the full flat layout (which copied ~25 MB per 1080p
-    GOP). Views only."""
+    dense = [il_dc | ic_dc] (the hadamard DC prefix, _per_gop_sparse;
+    with `ships_modes` also the [mode16 | dqp16] tail, appended to the
+    returned intra tuple), rest = [il_ac | ic_ac | P planes] — without
+    first concatenating them back into the full flat layout (which
+    copied ~25 MB per 1080p GOP). Views only."""
     nmb = mbw * mbh
     ndc, nlac = nmb * 16, nmb * 240
     dense = np.asarray(dense)
     rest = np.asarray(rest)
     il_dc = dense[:ndc].reshape(nmb, 16)
-    ic_dc = dense[ndc:].reshape(nmb, 2, 4)
+    ic_dc = dense[ndc:ndc + nmb * 8].reshape(nmb, 2, 4)
     il_ac = rest[:nlac].reshape(nmb, 16, 15)
     o = nlac + nmb * 120
     ic_ac = rest[nlac:o].reshape(nmb, 2, 4, 15)
     planes = unflatten_p_planes(rest[o:], mv8, num_frames, mbw, mbh)
-    return (il_dc, il_ac, ic_dc, ic_ac), planes
+    intra = (il_dc, il_ac, ic_dc, ic_ac)
+    if ships_modes:
+        t = ndc + nmb * 8
+        intra = intra + (dense[t:t + nmb], dense[t + nmb:t + 2 * nmb])
+    return intra, planes

@@ -57,6 +57,25 @@ DEFAULT_SETTINGS: dict[str, Any] = {
     "rc_mode": "cqp",                # cqp | vbr2pass
     "target_bitrate_kbps": 0.0,      # vbr2pass target; 0 = unset
     "qp": 27,
+    # rate-distortion features (codecs/h264/rdo.RdConfig; every
+    # settings-built encoder reads these — see the README's
+    # "Rate-distortion controls" section for the expected
+    # bits-at-quality effect of each knob):
+    # mode_decision (TVT_MODE_DECISION): per-MB SATD intra mode
+    #   decision (V/H/DC) instead of the fixed raster policy;
+    # pskip (TVT_PSKIP): P_Skip bias — near-zero inter residuals drop
+    #   so static MBs code as skip runs;
+    # deblock (TVT_DEBLOCK): §8.7 in-loop deblocking on the recon
+    #   carried between frames (signaled in the slice headers; SFE
+    #   runs it with a cross-band halo, and the remote planner keeps
+    #   deblock jobs on GOP shards);
+    # aq_strength (TVT_AQ_STRENGTH, 0..3): perceptual variance-AQ
+    #   per-MB QP modulation on intra frames (0 = off; quantized to
+    #   quarter steps — the config is a compile-time specialization).
+    "mode_decision": False,
+    "pskip": False,
+    "deblock": False,
+    "aq_strength": 0.0,
     # ABR ladder subsystem (abr/): default job type for registrations
     # that don't say (watch-folder drops named *.ladder.* always become
     # ladder jobs), the rung heights (TVT_LADDER_RUNGS; heights at or
@@ -294,6 +313,12 @@ def _clean_rung_spec(raw: Any) -> str:
 # POST /settings clamping (/root/reference/manager/app.py:1790-1916).
 _CLAMPS: dict[str, Callable[[Any], Any]] = {
     "qp": lambda v: min(51, max(0, as_int(v, 27))),
+    "mode_decision": lambda v: as_bool(v, False),
+    "pskip": lambda v: as_bool(v, False),
+    "deblock": lambda v: as_bool(v, False),
+    # cap mirrors rdo.aq_from_strength's 3.0 ceiling (clamped offsets
+    # saturate at ±AQ_MAX_DELTA well before that)
+    "aq_strength": lambda v: min(3.0, max(0.0, as_float(v, 0.0))),
     "gop_frames": lambda v: min(600, max(1, as_int(v, 32))),
     "max_segments": lambda v: min(4096, max(1, as_int(v, 200))),
     "drain_ratio": lambda v: min(1.0, max(0.0, as_float(v, 0.75))),
@@ -516,7 +541,10 @@ JOB_SETTING_KEYS = frozenset(
     {"gop_frames", "qp", "rc_mode", "target_bitrate_kbps",
      "max_segments", "profile_dir", "ladder_rungs", "segment_s",
      "live_stall_s", "dvr_window_s", "job_priority",
-     "live_part_budget_s", "sfe_bands", "sfe_halo_rows", "tenant"}
+     "live_part_budget_s", "sfe_bands", "sfe_halo_rows", "tenant",
+     # per-job RD operating point: a per-title encode may flip the
+     # compression-efficiency features without touching the cluster
+     "mode_decision", "pskip", "deblock", "aq_strength"}
 )
 
 

@@ -130,6 +130,7 @@ def _build_and_load() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_void_p,           # chroma dc/ac
             ctypes.c_int32, ctypes.c_int32,             # mbw, mbh
             ctypes.c_void_p, ctypes.c_int64,            # out, cap
+            ctypes.c_void_p,                            # qp_delta (or NULL)
         ]
         lib.cavlc_pack_islice.restype = ctypes.c_int64
         lib.cavlc_pack_islice.argtypes = _islice_sig
@@ -195,13 +196,16 @@ def pack_islice(header_bytes: bytes, header_bit_len: int,
                 luma_mode: np.ndarray, chroma_mode: np.ndarray,
                 luma_dc: np.ndarray, luma_ac: np.ndarray,
                 chroma_dc: np.ndarray, chroma_ac: np.ndarray,
-                mbw: int, mbh: int) -> bytes:
+                mbw: int, mbh: int,
+                qp_delta: np.ndarray | None = None) -> bytes:
     """Pack one I-slice (header bits + MB layer) and return the EBSP payload.
 
     When all four level arrays arrive as int16 (the flat transfer layout's
     views, parallel/dispatch._unflatten_gop) they go to the zero-copy
     `cavlc_pack_islice16` entry; anything else is widened to int32 and
     packed through the original entry. Identical bits either way.
+    `qp_delta` (per-MB qp offsets vs the slice qp, perceptual AQ) emits
+    chained mb_qp_delta values instead of se(0).
     """
     lib = _build_and_load()
     nmb = mbw * mbh
@@ -221,6 +225,10 @@ def pack_islice(header_bytes: bytes, header_bit_len: int,
     luma_ac = prep(luma_ac, (nmb, 16, 15), lvl)
     chroma_dc = prep(chroma_dc, (nmb, 2, 4), lvl)
     chroma_ac = prep(chroma_ac, (nmb, 2, 4, 15), lvl)
+    dqp_ptr = None
+    if qp_delta is not None:
+        qp_delta = prep(qp_delta, (nmb,), np.int8)
+        dqp_ptr = qp_delta.ctypes.data
 
     # CAVLC worst case ≈ 28 bits/coeff × 384 coeffs ≈ 1.4 KB per MB (plus
     # emulation-prevention expansion); 4 KB/MB is a safe ceiling.
@@ -233,7 +241,7 @@ def pack_islice(header_bytes: bytes, header_bit_len: int,
         luma_mode.ctypes.data, chroma_mode.ctypes.data,
         luma_dc.ctypes.data, luma_ac.ctypes.data,
         chroma_dc.ctypes.data, chroma_ac.ctypes.data,
-        mbw, mbh, out.ctypes.data, cap)
+        mbw, mbh, out.ctypes.data, cap, dqp_ptr)
     if n == -2:
         raise RuntimeError("native packer output buffer overflow")
     if n == -3:

@@ -221,7 +221,9 @@ static int64_t pack_islice_impl(
     const T* luma_ac,    // nmb*16*15
     const T* chroma_dc,  // nmb*2*4
     const T* chroma_ac,  // nmb*2*4*15
-    int32_t mbw, int32_t mbh, uint8_t* out, int64_t out_cap) {
+    int32_t mbw, int32_t mbh, uint8_t* out, int64_t out_cap,
+    const int8_t* qp_delta /* nmb per-MB qp offsets vs slice qp, or
+                              nullptr = flat QP (se(0) per MB) */) {
   if (!g_tables_ready || mbw <= 0 || mbh <= 0) return -1;
   // z-scan order of 4x4 luma blocks within a MB: (bx, by)
   static const int BX[16] = {0, 1, 0, 1, 2, 3, 2, 3, 0, 1, 0, 1, 2, 3, 2, 3};
@@ -248,6 +250,7 @@ static int64_t pack_islice_impl(
     return nc_from_counts(ccnt.data() + (size_t)ci * ch * cw, cw, gy, gx);
   };
 
+  int32_t prev_qp_off = 0;
   for (int my = 0; my < mbh; my++) {
     for (int mx = 0; mx < mbw; mx++) {
       const int mi = my * mbw + mx;
@@ -268,7 +271,14 @@ static int64_t pack_islice_impl(
       int mb_type = 1 + luma_mode[mi] + 4 * cbp_chroma + (cbp_luma ? 12 : 0);
       bw.ue((uint32_t)mb_type);
       bw.ue((uint32_t)chroma_mode[mi]);
-      bw.se(0);  // mb_qp_delta
+      if (qp_delta) {
+        // mb_qp_delta chains vs the previous MB's qp (§7.4.5);
+        // qp_delta[] holds offsets vs the slice qp.
+        bw.se((int32_t)qp_delta[mi] - prev_qp_off);
+        prev_qp_off = qp_delta[mi];
+      } else {
+        bw.se(0);  // mb_qp_delta
+      }
 
       const int by0 = 4 * my, bx0 = 4 * mx;
       if (encode_residual(bw, luma_dc + (size_t)mi * 16, 16,
@@ -377,10 +387,11 @@ int64_t cavlc_pack_islice(
     const int32_t* luma_mode, const int32_t* chroma_mode,
     const int32_t* luma_dc, const int32_t* luma_ac,
     const int32_t* chroma_dc, const int32_t* chroma_ac,
-    int32_t mbw, int32_t mbh, uint8_t* out, int64_t out_cap) {
+    int32_t mbw, int32_t mbh, uint8_t* out, int64_t out_cap,
+    const int8_t* qp_delta) {
   return pack_islice_impl(header_bytes, header_bit_len, luma_mode,
                           chroma_mode, luma_dc, luma_ac, chroma_dc,
-                          chroma_ac, mbw, mbh, out, out_cap);
+                          chroma_ac, mbw, mbh, out, out_cap, qp_delta);
 }
 
 // int16 entry: packs the flat transfer layout's level views directly.
@@ -389,10 +400,11 @@ int64_t cavlc_pack_islice16(
     const int32_t* luma_mode, const int32_t* chroma_mode,
     const int16_t* luma_dc, const int16_t* luma_ac,
     const int16_t* chroma_dc, const int16_t* chroma_ac,
-    int32_t mbw, int32_t mbh, uint8_t* out, int64_t out_cap) {
+    int32_t mbw, int32_t mbh, uint8_t* out, int64_t out_cap,
+    const int8_t* qp_delta) {
   return pack_islice_impl(header_bytes, header_bit_len, luma_mode,
                           chroma_mode, luma_dc, luma_ac, chroma_dc,
-                          chroma_ac, mbw, mbh, out, out_cap);
+                          chroma_ac, mbw, mbh, out, out_cap, qp_delta);
 }
 
 // Host inverse of jaxcore._block_sparse_pack2 over the three separate

@@ -79,8 +79,10 @@ from ..core.types import (BandPlan, ChromaFormat, EncodedSegment, Frame,
                           GopSpec, SegmentPlan, VideoMeta)
 from ..codecs.h264 import jaxcore
 from ..codecs.h264.encoder import (FrameLevels, _mode_policy,
-                                   gop_slice_thunks_planes, pack_slice)
+                                   gop_slice_thunks_planes, pack_slice,
+                                   unpack_mode16)
 from ..codecs.h264.headers import PPS, SPS
+from ..codecs.h264.rdo import RD_OFF, RdConfig, rd_from_settings
 # Transfer-layout contract (jax-free module shared with the process
 # pack sidecars): per-MB flat sizes + the zero-copy host unflattens.
 from ..codecs.h264.layout import _INTRA_FLAT_MB as _INTRA_MB
@@ -392,13 +394,19 @@ def _sparse_unpack2_host(nblk: int, nval: int, bitmap, bmask16, vals,
                                          vals, L)
 
 
-def _flat_levels(y, u, v, qp, mbw, mbh):
-    ldc, lac, cdc, cac = jaxcore._encode_intra(y, u, v, qp, mbw=mbw, mbh=mbh)
-    return jnp.concatenate([
-        ldc.reshape(-1), lac.reshape(-1), cdc.reshape(-1), cac.reshape(-1)])
+def _flat_levels(y, u, v, qp, mbw, mbh, rd=RD_OFF):
+    out = jaxcore._intra_core(y, u, v, qp, mbw=mbw, mbh=mbh, rd=rd)
+    ldc, lac, cdc, cac = out[:4]
+    parts = [ldc.reshape(-1), lac.reshape(-1), cdc.reshape(-1),
+             cac.reshape(-1)]
+    if rd.ships_modes:
+        parts.append(jaxcore._mode_tail(out[7], out[8], out[9])
+                     .astype(jnp.int32))
+    return jnp.concatenate(parts)
 
 
-def _per_gop_sparse(y, u, v, qp, mbw: int, mbh: int, compact: bool = False):
+def _per_gop_sparse(y, u, v, qp, mbw: int, mbh: int, compact: bool = False,
+                    rd=RD_OFF):
     """(F, H, W) GOP → (mv int8, dense intra-DC segments, two-tier
     sparse levels for the rest).
 
@@ -417,11 +425,21 @@ def _per_gop_sparse(y, u, v, qp, mbw: int, mbh: int, compact: bool = False):
     — instead of the 8-array (…, bitmap, bmask16, vals) layout."""
     from ..codecs.h264 import jaxinter
 
-    mv8, flat = jaxinter.encode_gop_planes(y, u, v, qp, mbw=mbw, mbh=mbh)
+    mv8, flat = jaxinter.encode_gop_planes(y, u, v, qp, mbw=mbw, mbh=mbh,
+                                           rd=rd)
     nmb = mbw * mbh
     ndc, nlac, ncdc = nmb * 16, nmb * 240, nmb * 8
-    dense = jnp.concatenate([flat[:ndc], flat[ndc + nlac:ndc + nlac + ncdc]])
-    rest = jnp.concatenate([flat[ndc:ndc + nlac], flat[ndc + nlac + ncdc:]])
+    dense_parts = [flat[:ndc], flat[ndc + nlac:ndc + nlac + ncdc]]
+    if rd.ships_modes:
+        # intra [mode16 | dqp16] tail rides the dense prefix (it is
+        # small and mode 0 = V would defeat the sparse pack anyway)
+        dense_parts.append(flat[-2 * nmb:])
+        rest = jnp.concatenate([flat[ndc:ndc + nlac],
+                                flat[ndc + nlac + ncdc:-2 * nmb]])
+    else:
+        rest = jnp.concatenate([flat[ndc:ndc + nlac],
+                                flat[ndc + nlac + ncdc:]])
+    dense = jnp.concatenate(dense_parts)
     nblk, nval, n_esc, bitmap, bmask16, vals = \
         jaxcore._block_sparse_pack2(rest)
     if not compact:
@@ -431,10 +449,11 @@ def _per_gop_sparse(y, u, v, qp, mbw: int, mbh: int, compact: bool = False):
     return (mv8, dense, nblk, nval, n_esc, used, payload)
 
 
-def _per_gop_dense(y, u, v, qp, mbw: int, mbh: int, dtype):
+def _per_gop_dense(y, u, v, qp, mbw: int, mbh: int, dtype, rd=RD_OFF):
     from ..codecs.h264 import jaxinter
 
-    _mv8, flat = jaxinter.encode_gop_planes(y, u, v, qp, mbw=mbw, mbh=mbh)
+    _mv8, flat = jaxinter.encode_gop_planes(y, u, v, qp, mbw=mbw, mbh=mbh,
+                                            rd=rd)
     return flat.astype(dtype)
 
 
@@ -447,9 +466,9 @@ _unflatten_gop_parts = unflatten_gop_parts
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("mbw", "mbh", "mesh", "compact"))
+                   static_argnames=("mbw", "mbh", "mesh", "compact", "rd"))
 def _encode_wave_gop(ys, us, vs, qps, *, mbw: int, mbh: int, mesh: Mesh,
-                     compact: bool = False):
+                     compact: bool = False, rd=RD_OFF):
     """ys: (G, F, H, W) uint8 sharded over `gop`, G = devices x k; each
     device sequentially encodes its k GOPs (IDR + P, jaxinter) at its
     per-GOP QP (qps: (G,) int32, the rate-control hook) and sparse-packs
@@ -459,7 +478,8 @@ def _encode_wave_gop(ys, us, vs, qps, *, mbw: int, mbh: int, mesh: Mesh,
     def per_dev(y_g, u_g, v_g, qp_g):
         def one(args):
             y, u, v, qp = args
-            return _per_gop_sparse(y, u, v, qp, mbw, mbh, compact=compact)
+            return _per_gop_sparse(y, u, v, qp, mbw, mbh, compact=compact,
+                                   rd=rd)
         return jax.lax.map(one, (y_g, u_g, v_g, qp_g))
 
     shard = shard_map(
@@ -470,36 +490,41 @@ def _encode_wave_gop(ys, us, vs, qps, *, mbw: int, mbh: int, mesh: Mesh,
     return shard(ys, us, vs, qps)
 
 
-@functools.partial(jax.jit, static_argnames=("mbw", "mbh", "compact"))
+@functools.partial(jax.jit,
+                   static_argnames=("mbw", "mbh", "compact", "rd"))
 def _encode_gop_single(ys, us, vs, qps, *, mbw: int, mbh: int,
-                       compact: bool = False):
+                       compact: bool = False, rd=RD_OFF):
     """Single-device wave: the same per-GOP program WITHOUT the
     shard_map wrapper. On one chip shard_map buys nothing and costs a
     lot — measured on TPU v5e: compile 33 s → 810 s and steady-state
     256 ms → 800 ms per 1080p GOP under the manual-axes lowering."""
     def one(args):
         y, u, v, qp = args
-        return _per_gop_sparse(y, u, v, qp, mbw, mbh, compact=compact)
+        return _per_gop_sparse(y, u, v, qp, mbw, mbh, compact=compact,
+                               rd=rd)
     return jax.lax.map(one, (ys, us, vs, qps))
 
 
-@functools.partial(jax.jit, static_argnames=("mbw", "mbh", "dtype"))
-def _encode_gop_single_dense(ys, us, vs, qps, *, mbw: int, mbh: int, dtype):
+@functools.partial(jax.jit,
+                   static_argnames=("mbw", "mbh", "dtype", "rd"))
+def _encode_gop_single_dense(ys, us, vs, qps, *, mbw: int, mbh: int, dtype,
+                             rd=RD_OFF):
     def one(args):
         y, u, v, qp = args
-        return _per_gop_dense(y, u, v, qp, mbw, mbh, dtype)
+        return _per_gop_dense(y, u, v, qp, mbw, mbh, dtype, rd=rd)
     return jax.lax.map(one, (ys, us, vs, qps))
 
 
-@functools.partial(jax.jit, static_argnames=("mbw", "mbh", "mesh", "dtype"))
+@functools.partial(jax.jit,
+                   static_argnames=("mbw", "mbh", "mesh", "dtype", "rd"))
 def _encode_wave_gop_dense(ys, us, vs, qps, *, mbw: int, mbh: int, mesh: Mesh,
-                           dtype):
+                           dtype, rd=RD_OFF):
     """Dense fallback for the GOP wave: (G, L) levels in `dtype`."""
 
     def per_dev(y_g, u_g, v_g, qp_g):
         def one(args):
             y, u, v, qp = args
-            return _per_gop_dense(y, u, v, qp, mbw, mbh, dtype)
+            return _per_gop_dense(y, u, v, qp, mbw, mbh, dtype, rd=rd)
         return jax.lax.map(one, (y_g, u_g, v_g, qp_g))
 
     shard = shard_map(
@@ -510,8 +535,9 @@ def _encode_wave_gop_dense(ys, us, vs, qps, *, mbw: int, mbh: int, mesh: Mesh,
     return shard(ys, us, vs, qps)
 
 
-@functools.partial(jax.jit, static_argnames=("mbw", "mbh", "mesh"))
-def _encode_wave(ys, us, vs, qps, *, mbw: int, mbh: int, mesh: Mesh):
+@functools.partial(jax.jit, static_argnames=("mbw", "mbh", "mesh", "rd"))
+def _encode_wave(ys, us, vs, qps, *, mbw: int, mbh: int, mesh: Mesh,
+                 rd=RD_OFF):
     """All-intra wave. ys: (G, F, H, W) uint8 sharded over `gop`; qps:
     (G,) int32 per-GOP QP — the rate-control hook (this path used to
     take one wave-wide scalar, silently encoding every GOP at base QP
@@ -528,7 +554,7 @@ def _encode_wave(ys, us, vs, qps, *, mbw: int, mbh: int, mesh: Mesh):
             def per_frame(planes):
                 y, u, v = planes
                 return jaxcore._sparse_pack(
-                    _flat_levels(y, u, v, qp1, mbw, mbh))
+                    _flat_levels(y, u, v, qp1, mbw, mbh, rd=rd))
 
             return jax.lax.map(per_frame, (y_f, u_f, v_f))
 
@@ -542,9 +568,10 @@ def _encode_wave(ys, us, vs, qps, *, mbw: int, mbh: int, mesh: Mesh):
     return shard(ys, us, vs, qps)
 
 
-@functools.partial(jax.jit, static_argnames=("mbw", "mbh", "mesh", "dtype"))
+@functools.partial(jax.jit,
+                   static_argnames=("mbw", "mbh", "mesh", "dtype", "rd"))
 def _encode_wave_dense(ys, us, vs, qps, *, mbw: int, mbh: int, mesh: Mesh,
-                       dtype):
+                       dtype, rd=RD_OFF):
     """Dense fallback: (G, F, L) levels in `dtype` (int16 covers the full
     CAVLC level range), at the same per-GOP QPs as the sparse pass."""
 
@@ -552,7 +579,7 @@ def _encode_wave_dense(ys, us, vs, qps, *, mbw: int, mbh: int, mesh: Mesh,
         def one(y_f, u_f, v_f, qp1):
             def per_frame(planes):
                 y, u, v = planes
-                return _flat_levels(y, u, v, qp1, mbw, mbh)
+                return _flat_levels(y, u, v, qp1, mbw, mbh, rd=rd)
 
             return jax.lax.map(per_frame, (y_f, u_f, v_f))
 
@@ -576,7 +603,8 @@ class GopShardEncoder:
                  pipeline_window: int | None = None,
                  decode_ahead: int | None = None,
                  compact_transfer: bool | None = None,
-                 pack_backend: str | None = None):
+                 pack_backend: str | None = None,
+                 rd: RdConfig | None = None):
         self.meta = meta
         self.qp = qp
         #: inter=True encodes each GOP as IDR + P frames (motion-coded);
@@ -593,6 +621,19 @@ class GopShardEncoder:
                        fps_num=meta.fps_num, fps_den=meta.fps_den)
         self.pps = PPS(init_qp=qp)
         snap = get_settings()
+        #: static RD feature set (codecs/h264/rdo.RdConfig): per-MB
+        #: intra mode decision, P_Skip bias, in-loop deblocking,
+        #: perceptual AQ. None resolves from settings (the
+        #: mode_decision/pskip/deblock/aq_strength knobs) so every
+        #: settings-built encoder — executor, remote worker, ladder,
+        #: live — inherits the job's RD config without new plumbing.
+        if rd is None:
+            rd = rd_from_settings(snap)
+        self.rd = rd
+        if self.rd.deblock and not inter:
+            raise ValueError(
+                "deblock requires the inter (GOP) path: the all-intra "
+                "encoder has no recon chain to filter")
         #: slice-granular CAVLC pack threads (0/None in config = all
         #: cores). Decoupled from the wave window: the pack pool sizes
         #: to the HOST (cpu count), the window to device queue depth.
@@ -776,13 +817,15 @@ class GopShardEncoder:
             compact = self.inter and self.compact_transfer
             if self.inter and self.num_devices == 1:
                 out = _encode_gop_single(ysd, usd, vsd, qpsd, mbw=mbw,
-                                         mbh=mbh, compact=compact)
+                                         mbh=mbh, compact=compact,
+                                         rd=self.rd)
             elif self.inter:
                 out = _encode_wave_gop(ysd, usd, vsd, qpsd, mbw=mbw, mbh=mbh,
-                                       mesh=self.mesh, compact=compact)
+                                       mesh=self.mesh, compact=compact,
+                                       rd=self.rd)
             else:
                 out = _encode_wave(ysd, usd, vsd, qpsd, mbw=mbw, mbh=mbh,
-                                   mesh=self.mesh)
+                                   mesh=self.mesh, rd=self.rd)
             if not self._async_copy_unavailable:
                 for i, arr in enumerate(out):
                     # Start the device->host copies now, overlapped with
@@ -1002,7 +1045,8 @@ class GopShardEncoder:
         del buf     # shm.close() refuses while exported views exist
         args = (shm.name, mv.nbytes, dn.nbytes, pl.nbytes, nblk, nval,
                 gop.num_frames, F, mbw, mbh, _dc.asdict(self.sps),
-                _dc.asdict(self.pps), gop_qp, gop.index)
+                _dc.asdict(self.pps), gop_qp, gop.index,
+                _dc.asdict(self.rd))
         try:
             fut = proc.submit(packproc.pack_gop_from_shm, *args)
         except Exception:
@@ -1031,8 +1075,10 @@ class GopShardEncoder:
         prof = self.stages
         F = ysd.shape[1]
         nmb = mbw * mbh
-        L = (nmb * _INTRA_MB + (F - 1) * nmb * _P_FLAT_MB if self.inter
-             else nmb * _INTRA_MB)
+        ships_modes = self.rd.ships_modes
+        tail = 2 * nmb if ships_modes else 0     # [mode16 | dqp16]
+        L = (nmb * _INTRA_MB + (F - 1) * nmb * _P_FLAT_MB + tail
+             if self.inter else nmb * _INTRA_MB + tail)
         compact = self.inter and self.compact_transfer
         # Barrier on the tiny count outputs first: they complete when
         # the wave's compute does, splitting "waiting on the device"
@@ -1050,9 +1096,10 @@ class GopShardEncoder:
         if self.inter:
             nblk, nval, n_esc = tiny[0], tiny[1], tiny[2]
             # dense prefix = both intra hadamard DC segments (luma +
-            # chroma); the sparse remainder skips them (_per_gop_sparse)
+            # chroma) + the mode/dqp tail when shipped; the sparse
+            # remainder skips them (_per_gop_sparse)
             ndc, ncdc = nmb * 16, nmb * 8
-            Lr = L - ndc - ncdc
+            Lr = L - ndc - ncdc - tail
             sparse_ok = jaxcore.block_sparse2_fits(
                 nblk.max(), nval.max(), n_esc.max(), Lr)
             if sparse_ok:
@@ -1083,15 +1130,15 @@ class GopShardEncoder:
                 if self.inter and self.num_devices == 1:
                     flat = jax.device_get(_encode_gop_single_dense(
                         ysd, usd, vsd, qpsd, mbw=mbw, mbh=mbh,
-                        dtype=jnp.int16))
+                        dtype=jnp.int16, rd=self.rd))
                 elif self.inter:
                     flat = jax.device_get(_encode_wave_gop_dense(
                         ysd, usd, vsd, qpsd, mbw=mbw, mbh=mbh,
-                        mesh=self.mesh, dtype=jnp.int16))
+                        mesh=self.mesh, dtype=jnp.int16, rd=self.rd))
                 else:
                     flat = jax.device_get(_encode_wave_dense(
                         ysd, usd, vsd, qpsd, mbw=mbw, mbh=mbh,
-                        mesh=self.mesh, dtype=jnp.int16))
+                        mesh=self.mesh, dtype=jnp.int16, rd=self.rd))
                 prof.bump("d2h_bytes", int(flat.nbytes))
                 if self.inter:
                     # the dense program re-emits levels only; MVs still
@@ -1142,16 +1189,18 @@ class GopShardEncoder:
                                 bmask16[gi], vals[gi], Lr)
                     with prof.stage("unflatten"):
                         intra, planes = unflatten_gop_parts(
-                            dc16[gi], rest, mv8[gi], F, mbw, mbh)
+                            dc16[gi], rest, mv8[gi], F, mbw, mbh,
+                            ships_modes=ships_modes)
                 else:
                     with prof.stage("unflatten"):
                         intra, planes = unflatten_gop(
-                            flat[gi], mv8[gi], F, mbw, mbh)
+                            flat[gi], mv8[gi], F, mbw, mbh,
+                            ships_modes=ships_modes)
                 # gop.num_frames (not F) drops the wave's tail-repeat
                 # padding.
                 thunks = gop_slice_thunks_planes(
                     intra, planes, gop.num_frames, mbw, mbh, self.sps,
-                    self.pps, gop_qp, idr_pic_id=gop.index)
+                    self.pps, gop_qp, idr_pic_id=gop.index, rd=self.rd)
             else:
                 thunks = []
                 for fi in range(gop.num_frames):
@@ -1193,7 +1242,7 @@ class GopShardEncoder:
                           fi: int, qp: int) -> bytes:
         """Pack one all-intra frame's IDR slice (+ SPS/PPS at the GOP
         head) from its flat levels — the intra path's slice-pool unit."""
-        levels = jaxcore._unpack_levels(raw, mbw, mbh)
+        levels = jaxcore._unpack_levels(raw, mbw, mbh, self.rd)
         nal = pack_slice(levels, mbw, mbh, self.sps, self.pps, qp,
                          idr=True,
                          idr_pic_id=(gop.start_frame + fi) % 65536)
@@ -1298,9 +1347,10 @@ def _sfe_pack_band(flat):
     return nblk, nval, n_esc, used, payload
 
 
-@functools.partial(jax.jit, static_argnames=("mbw", "mbh_band", "mesh"))
+@functools.partial(jax.jit, static_argnames=("mbw", "mbh_band", "mesh",
+                                             "rd", "total_mb_rows"))
 def _sfe_intra_step(y, u, v, qp, real_rows, *, mbw: int, mbh_band: int,
-                    mesh: Mesh | None):
+                    mesh: Mesh | None, rd=RD_OFF, total_mb_rows: int = 0):
     """One IDR frame, banded: y/u/v are full (padded) frame planes
     sharded over rows; each band runs the slice-local intra core and
     compact-packs its level streams. Returns per-band transfer arrays
@@ -1313,7 +1363,10 @@ def _sfe_intra_step(y, u, v, qp, real_rows, *, mbw: int, mbh_band: int,
 
     def per_band(y_b, u_b, v_b, qp_, real_b):
         dense, rest, (ry, ru, rv, pmv) = jaxinter.sfe_intra_band(
-            y_b, u_b, v_b, qp_, real_b[0, 0], mbw=mbw, mbh_band=mbh_band)
+            y_b, u_b, v_b, qp_, real_b[0, 0], mbw=mbw, mbh_band=mbh_band,
+            rd=rd, total_mb_rows=total_mb_rows,
+            axis_name="band" if mesh is not None else None,
+            num_bands=mesh.devices.size if mesh is not None else 1)
         nblk, nval, n_esc, used, payload = _sfe_pack_band(rest)
         return (dense[None], nblk[None], nval[None], n_esc[None],
                 used[None], payload[None], ry, ru, rv, pmv[None])
@@ -1328,10 +1381,11 @@ def _sfe_intra_step(y, u, v, qp, real_rows, *, mbw: int, mbh_band: int,
 
 
 @functools.partial(jax.jit, static_argnames=("mbw", "mbh_band", "mesh",
-                                             "halo_rows", "num_bands"))
+                                             "halo_rows", "num_bands",
+                                             "rd", "total_mb_rows"))
 def _sfe_p_step(y, u, v, ry, ru, rv, pmv, qp, real_rows, *, mbw: int,
                 mbh_band: int, mesh: Mesh | None, halo_rows: int,
-                num_bands: int):
+                num_bands: int, rd=RD_OFF, total_mb_rows: int = 0):
     """One P frame, banded: the halo exchange + psum'd search centers
     live inside jaxinter.sfe_p_band; this wrapper shards the frame and
     recon carry over rows and compact-packs each band's levels.
@@ -1343,7 +1397,8 @@ def _sfe_p_step(y, u, v, ry, ru, rv, pmv, qp, real_rows, *, mbw: int,
             y_b, u_b, v_b, (ry_b, ru_b, rv_b, pmv_b[0]), qp_,
             real_b[0, 0], mbw=mbw, mbh_band=mbh_band,
             halo_rows=halo_rows, num_bands=num_bands,
-            axis_name="band" if mesh is not None else None)
+            axis_name="band" if mesh is not None else None,
+            rd=rd, total_mb_rows=total_mb_rows)
         nblk, nval, n_esc, used, payload = _sfe_pack_band(flat)
         return (mv8[None], nblk[None], nval[None], n_esc[None],
                 used[None], payload[None], ry2, ru2, rv2, med[None])
@@ -1357,16 +1412,21 @@ def _sfe_p_step(y, u, v, ry, ru, rv, pmv, qp, real_rows, *, mbw: int,
     return shard(y, u, v, ry, ru, rv, pmv, qp, real_rows)
 
 
-@functools.partial(jax.jit, static_argnames=("mbw", "mbh_band", "mesh"))
+@functools.partial(jax.jit, static_argnames=("mbw", "mbh_band", "mesh",
+                                             "rd", "total_mb_rows"))
 def _sfe_intra_step_dense(y, u, v, qp, real_rows, *, mbw: int,
-                          mbh_band: int, mesh: Mesh | None):
+                          mbh_band: int, mesh: Mesh | None, rd=RD_OFF,
+                          total_mb_rows: int = 0):
     """Escape fallback: the same intra step emitting the flat int16
     levels uncompressed (layout.unflatten_intra's inverse per band)."""
     from ..codecs.h264 import jaxinter
 
     def per_band(y_b, u_b, v_b, qp_, real_b):
         flat, (ry, ru, rv, pmv) = jaxinter.sfe_intra_band_dense(
-            y_b, u_b, v_b, qp_, real_b[0, 0], mbw=mbw, mbh_band=mbh_band)
+            y_b, u_b, v_b, qp_, real_b[0, 0], mbw=mbw, mbh_band=mbh_band,
+            rd=rd, total_mb_rows=total_mb_rows,
+            axis_name="band" if mesh is not None else None,
+            num_bands=mesh.devices.size if mesh is not None else 1)
         return flat[None], ry, ru, rv, pmv[None]
 
     if mesh is None:
@@ -1378,10 +1438,12 @@ def _sfe_intra_step_dense(y, u, v, qp, real_rows, *, mbw: int,
 
 
 @functools.partial(jax.jit, static_argnames=("mbw", "mbh_band", "mesh",
-                                             "halo_rows", "num_bands"))
+                                             "halo_rows", "num_bands",
+                                             "rd", "total_mb_rows"))
 def _sfe_p_step_dense(y, u, v, ry, ru, rv, pmv, qp, real_rows, *,
                       mbw: int, mbh_band: int, mesh: Mesh | None,
-                      halo_rows: int, num_bands: int):
+                      halo_rows: int, num_bands: int, rd=RD_OFF,
+                      total_mb_rows: int = 0):
     from ..codecs.h264 import jaxinter
 
     def per_band(y_b, u_b, v_b, ry_b, ru_b, rv_b, pmv_b, qp_, real_b):
@@ -1389,7 +1451,8 @@ def _sfe_p_step_dense(y, u, v, ry, ru, rv, pmv, qp, real_rows, *,
             y_b, u_b, v_b, (ry_b, ru_b, rv_b, pmv_b[0]), qp_,
             real_b[0, 0], mbw=mbw, mbh_band=mbh_band,
             halo_rows=halo_rows, num_bands=num_bands,
-            axis_name="band" if mesh is not None else None)
+            axis_name="band" if mesh is not None else None,
+            rd=rd, total_mb_rows=total_mb_rows)
         return mv8[None], flat[None], ry2, ru2, rv2, med[None]
 
     if mesh is None:
@@ -1444,11 +1507,11 @@ def _sfe_probe_step(cur_y, ref_y, real_rows, top_y, bot_y, edges, *,
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "mbw", "mbh_band", "mesh", "halo_rows", "num_bands"))
+    "mbw", "mbh_band", "mesh", "halo_rows", "num_bands", "rd"))
 def _sfe_p_step_farm(y, u, v, ry, ru, rv, pred_mv, probe, ty, by, tu,
                      bu, tv, bv, qp, real_rows, edges, *, mbw: int,
                      mbh_band: int, mesh: Mesh | None, halo_rows: int,
-                     num_bands: int):
+                     num_bands: int, rd=RD_OFF):
     """One P frame of a band SLICE: the search runs on halo-extended
     planes whose slice-edge rows were injected by the host (`ty..bv`,
     band-sharded — only the edge bands' shards are read), the probe
@@ -1469,7 +1532,7 @@ def _sfe_p_step_farm(y, u, v, ry, ru, rv, pred_mv, probe, ty, by, tu,
             axis_name="band" if mesh is not None else None,
             ext=(ty_b, by_b, tu_b, bu_b, tv_b, bv_b),
             edge_top=edges_[0], edge_bot=edges_[1], probe=probe_,
-            return_hist=True)
+            return_hist=True, rd=rd)
         nblk, nval, n_esc, used, payload = _sfe_pack_band(flat)
         return (mv8[None], nblk[None], nval[None], n_esc[None],
                 used[None], payload[None], cnt[None],
@@ -1488,11 +1551,11 @@ def _sfe_p_step_farm(y, u, v, ry, ru, rv, pred_mv, probe, ty, by, tu,
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "mbw", "mbh_band", "mesh", "halo_rows", "num_bands"))
+    "mbw", "mbh_band", "mesh", "halo_rows", "num_bands", "rd"))
 def _sfe_p_step_farm_dense(y, u, v, ry, ru, rv, pred_mv, probe, ty, by,
                            tu, bu, tv, bv, qp, real_rows, edges, *,
                            mbw: int, mbh_band: int, mesh: Mesh | None,
-                           halo_rows: int, num_bands: int):
+                           halo_rows: int, num_bands: int, rd=RD_OFF):
     """Escape fallback for the farm P step: same compute, uncompressed
     int16 levels. The replay is host-local (the cached per-frame
     injected inputs fully determine this slice's bits), so no
@@ -1508,7 +1571,7 @@ def _sfe_p_step_farm_dense(y, u, v, ry, ru, rv, pred_mv, probe, ty, by,
             axis_name="band" if mesh is not None else None,
             ext=(ty_b, by_b, tu_b, bu_b, tv_b, bv_b),
             edge_top=edges_[0], edge_bot=edges_[1], probe=probe_,
-            return_hist=True)
+            return_hist=True, rd=rd)
         return mv8[None], flat[None], ry2, ru2, rv2
 
     if mesh is None:
@@ -1554,7 +1617,8 @@ class SfeShardEncoder(GopShardEncoder):
                  pipeline_window: int | None = None,
                  decode_ahead: int | None = None,
                  total_bands: int = 0,
-                 band_range: tuple[int, int] | None = None):
+                 band_range: tuple[int, int] | None = None,
+                 rd: RdConfig | None = None):
         snap = get_settings()
         full_mesh = mesh if mesh is not None else default_mesh()
         devices = list(full_mesh.devices.flat)
@@ -1602,7 +1666,7 @@ class SfeShardEncoder(GopShardEncoder):
                          pack_workers=pack_workers,
                          pipeline_window=pipeline_window,
                          decode_ahead=decode_ahead,
-                         pack_backend="thread")
+                         pack_backend="thread", rd=rd)
         if halo_rows is None:
             halo_rows = int(snap.get("sfe_halo_rows", 32) or 32)
         #: reference rows exchanged per side (multiple of 16). >= 23
@@ -1633,6 +1697,26 @@ class SfeShardEncoder(GopShardEncoder):
         #: threads in completion order
         self.keep_recon = False
         self.recon_frames: dict[int, tuple] = {}
+        # RD feature gates for the banded shape: perceptual AQ would
+        # make the per-band activity mean band-local (a different map
+        # than the unbanded program) — strip it with a log line rather
+        # than encode something byte-different per band count; the
+        # in-loop filter needs the cross-band halo exchange, which the
+        # cross-host (farm) slices cannot run in one device program.
+        if self.rd.aq_q:
+            _LOG.warning("perceptual AQ is not supported by split-frame "
+                         "encoding; encoding this job with aq off")
+            import dataclasses as _dc
+
+            self.rd = _dc.replace(self.rd, aq_q=0)
+        if self.rd.deblock and (self.band_lo, self.band_hi) != (
+                0, self.global_band_plan.num_bands):
+            raise ValueError(
+                "deblock is not supported on cross-host band slices; "
+                "the remote planner must fall back to GOP shards")
+        #: the picture's REAL MB rows (band-grid padding rows beyond it
+        #: carry no coded MBs): the deblock masks key off this
+        self._total_mb_rows = mbh
         bp = self.band_plan
         self._real_rows = jax.device_put(
             np.asarray([[b.mb_rows * 16] for b in bp.bands], np.int32),
@@ -1718,7 +1802,8 @@ class SfeShardEncoder(GopShardEncoder):
         bp = self.band_plan
         return _sfe_intra_step(y, u, v, qp, self._real_rows,
                                mbw=bp.mb_width, mbh_band=bp.band_mb_rows,
-                               mesh=self._step_mesh())
+                               mesh=self._step_mesh(), rd=self.rd,
+                               total_mb_rows=self._total_mb_rows)
 
     def _p_step(self, y, u, v, carry, qp):
         bp = self.band_plan
@@ -1727,7 +1812,8 @@ class SfeShardEncoder(GopShardEncoder):
                            mbw=bp.mb_width, mbh_band=bp.band_mb_rows,
                            mesh=self._step_mesh(),
                            halo_rows=self.halo_rows,
-                           num_bands=bp.num_bands)
+                           num_bands=bp.num_bands, rd=self.rd,
+                           total_mb_rows=self._total_mb_rows)
 
     def dispatch_wave(self, staged: tuple) -> tuple:
         """Enqueue one GOP's per-frame steps (all async — jax dispatch
@@ -1772,13 +1858,20 @@ class SfeShardEncoder(GopShardEncoder):
                            idr_pic_id: int) -> bytes:
         """Shared tail of the sparse and dense-fallback intra band
         packs (which must stay bit-identical): truncate to the band's
-        REAL MB rows and emit its IDR band slice."""
+        REAL MB rows and emit its IDR band slice. The mode raster —
+        shipped per MB when rd.ships_modes, the slice-local
+        _mode_policy otherwise — is BAND-relative either way: the
+        band's first MB row is its slice's row 0."""
         bp = self.band_plan
         band = bp.bands[bi]
         mbw = bp.mb_width
-        il_dc, il_ac, ic_dc, ic_ac = intra
         n_real = band.mb_rows * mbw
-        luma_mode, chroma_mode = _mode_policy(mbw, band.mb_rows)
+        if len(intra) == 6:
+            il_dc, il_ac, ic_dc, ic_ac, mode16, _dqp = intra
+            luma_mode, chroma_mode = unpack_mode16(mode16[:n_real])
+        else:
+            il_dc, il_ac, ic_dc, ic_ac = intra
+            luma_mode, chroma_mode = _mode_policy(mbw, band.mb_rows)
         levels = FrameLevels(
             luma_mode=luma_mode, chroma_mode=chroma_mode,
             luma_dc=il_dc[:n_real], luma_ac=il_ac[:n_real],
@@ -1786,14 +1879,16 @@ class SfeShardEncoder(GopShardEncoder):
         return pack_slice(levels, mbw, band.mb_rows, self.sps, self.pps,
                           qp, frame_num=0, idr=True,
                           idr_pic_id=idr_pic_id,
-                          first_mb=band.start_mb_row * mbw)
+                          first_mb=band.start_mb_row * mbw,
+                          deblock=self.rd.deblock)
 
     def _pack_intra_band(self, dense_b, rest, bi: int, qp: int,
                          idr_pic_id: int) -> bytes:
         bp = self.band_plan
         intra = unflatten_gop_parts(dense_b, rest,
                                     np.empty((0, 0, 2), np.int8), 1,
-                                    bp.mb_width, bp.band_mb_rows)[0]
+                                    bp.mb_width, bp.band_mb_rows,
+                                    ships_modes=self.rd.ships_modes)[0]
         return self._pack_intra_levels(intra, bi, qp, idr_pic_id)
 
     def _pack_p_band(self, mv8_b, rest, bi: int, qp: int,
@@ -1811,7 +1906,7 @@ class SfeShardEncoder(GopShardEncoder):
             mv[:n_real], lp[0][:rr], udc[0][:n_real], vdc[0][:n_real],
             uac[0][:rr // 2], vac[0][:rr // 2], mbw, band.mb_rows,
             self.sps, self.pps, qp, frame_num=frame_num,
-            first_mb=band.start_mb_row * mbw)
+            first_mb=band.start_mb_row * mbw, deblock=self.rd.deblock)
 
     def _gather_frame(self, thunks: list) -> list[bytes]:
         pool = self._slice_pool()
@@ -1934,14 +2029,16 @@ class SfeShardEncoder(GopShardEncoder):
                     r = _sfe_intra_step_dense(
                         ys[0], us[0], vs[0], qpj, self._real_rows,
                         mbw=bp.mb_width, mbh_band=bp.band_mb_rows,
-                        mesh=mesh)
+                        mesh=mesh, rd=self.rd,
+                        total_mb_rows=self._total_mb_rows)
                     head, flat, carry = None, r[0], r[1:]
                 else:
                     r = _sfe_p_step_dense(
                         ys[fi], us[fi], vs[fi], *carry[:3], carry[3],
                         qpj, self._real_rows, mbw=bp.mb_width,
                         mbh_band=bp.band_mb_rows, mesh=mesh,
-                        halo_rows=self.halo_rows, num_bands=bp.num_bands)
+                        halo_rows=self.halo_rows, num_bands=bp.num_bands,
+                        rd=self.rd, total_mb_rows=self._total_mb_rows)
                     head, flat, carry = r[0], r[1], r[2:]
                 if fi < dense_from:
                     continue            # already packed from sparse
@@ -1976,7 +2073,11 @@ class SfeShardEncoder(GopShardEncoder):
                                idr_pic_id: int) -> bytes:
         bp = self.band_plan
         nmb = bp.mb_width * bp.band_mb_rows
-        intra = unflatten_intra(np.asarray(flat_b), nmb)
+        flat_b = np.asarray(flat_b)
+        intra = unflatten_intra(flat_b[:nmb * _INTRA_MB], nmb)
+        if self.rd.ships_modes:
+            t = nmb * _INTRA_MB
+            intra = intra + (flat_b[t:t + nmb], flat_b[t + nmb:])
         return self._pack_intra_levels(intra, bi, qp, idr_pic_id)
 
     def frame_latencies_ms(self) -> list[float]:

@@ -31,14 +31,16 @@ from ..codecs.h264.layout import (rest_len, unflatten_gop_parts,
 def _pack_from_buf(buf, n_mv: int, n_dense: int, nblk: int, nval: int,
                    num_frames: int, wave_frames: int, mbw: int,
                    mbh: int, sps_kw: dict, pps_kw: dict, qp: int,
-                   idr_pic_id: int) -> list[bytes]:
+                   idr_pic_id: int, rd_kw: dict | None) -> list[bytes]:
     """The actual unpack+pack over a raw buffer. Its own frame on
     purpose: every numpy view into the shared-memory buffer dies when
     it returns, so the caller's shm.close() finds no exported
     pointers."""
     from ..codecs.h264.encoder import gop_slice_thunks_planes
     from ..codecs.h264.headers import PPS, SPS
+    from ..codecs.h264.rdo import RD_OFF, RdConfig
 
+    rd = RdConfig(**rd_kw) if rd_kw else RD_OFF
     nmb = mbw * mbh
     F1 = wave_frames - 1
     arr = np.frombuffer(buf, np.uint8)
@@ -48,10 +50,11 @@ def _pack_from_buf(buf, n_mv: int, n_dense: int, nblk: int, nval: int,
     Lr = rest_len(wave_frames, mbw, mbh)
     rest = unpack_compact_auto(payload, nblk, nval, Lr)
     intra, planes = unflatten_gop_parts(dense, rest, mv8,
-                                        wave_frames, mbw, mbh)
+                                        wave_frames, mbw, mbh,
+                                        ships_modes=rd.ships_modes)
     thunks = gop_slice_thunks_planes(
         intra, planes, num_frames, mbw, mbh, SPS(**sps_kw),
-        PPS(**pps_kw), qp, idr_pic_id=idr_pic_id)
+        PPS(**pps_kw), qp, idr_pic_id=idr_pic_id, rd=rd)
     return [t() for t in thunks]
 
 
@@ -59,7 +62,8 @@ def pack_gop_from_shm(shm_name: str, n_mv: int, n_dense: int,
                       n_payload: int, nblk: int, nval: int,
                       num_frames: int, wave_frames: int, mbw: int,
                       mbh: int, sps_kw: dict, pps_kw: dict, qp: int,
-                      idr_pic_id: int) -> list[bytes]:
+                      idr_pic_id: int,
+                      rd_kw: dict | None = None) -> list[bytes]:
     """Unpack + entropy-pack ONE GOP from a shared-memory spool.
 
     The block holds ``[mv8 | dense | compact payload]`` back to back
@@ -78,7 +82,7 @@ def pack_gop_from_shm(shm_name: str, n_mv: int, n_dense: int,
         return _pack_from_buf(
             memoryview(shm.buf)[:n_mv + n_dense + n_payload], n_mv,
             n_dense, nblk, nval, num_frames, wave_frames, mbw, mbh,
-            sps_kw, pps_kw, qp, idr_pic_id)
+            sps_kw, pps_kw, qp, idr_pic_id, rd_kw)
     finally:
         try:
             shm.close()

@@ -95,6 +95,22 @@ def analyze_complexity(enc: GopShardEncoder, frames: list[Frame]
     return arr / max(sum(wave_totals), 1e-9)
 
 
+def jnd_masked_shares(shares: np.ndarray, aq_strength: float
+                      ) -> np.ndarray:
+    """Perceptual (JND/masking) weighting of complexity shares for the
+    octave-model solve: a busy GOP masks its own coding error (Weber —
+    the same activity-masking premise as the per-MB variance AQ in
+    codecs/h264/rdo), so its effective bit DEMAND grows sublinearly
+    with measured complexity. shares^(1/(1+s/2)), renormalized; s = 0
+    returns the input — the historical allocation — exactly."""
+    s = np.asarray(shares, np.float64)
+    if aq_strength <= 0 or s.size == 0:
+        return s
+    exponent = 1.0 / (1.0 + float(aq_strength) / 2.0)
+    out = np.power(np.maximum(s, 1e-12), exponent)
+    return out / out.sum()
+
+
 def solve_gop_qps(base_qp: int, pass1_bytes: np.ndarray,
                   shares: np.ndarray, target_bits_total: float,
                   modulation: float = 2.0) -> np.ndarray:
@@ -160,6 +176,7 @@ def encode_vbr2pass(frames: list[Frame], meta: VideoMeta,
                     gops_per_wave: int = 4, tolerance: float = 0.08,
                     max_refine: int = 3, enc: GopShardEncoder | None = None,
                     encode_fn=None, on_pass=None,
+                    aq_strength: float = 0.0,
                     ) -> tuple[list[EncodedSegment], dict]:
     """Two-pass VBR encode (+ up to `max_refine` correction passes when
     the octave model misses — e.g. clips whose flat stretches are
@@ -185,7 +202,10 @@ def encode_vbr2pass(frames: list[Frame], meta: VideoMeta,
 
     if on_pass is not None:
         on_pass(1, None)
-    shares = analyze_complexity(enc, frames)
+    # aq_strength > 0 also masks the GOP-level allocation: the octave
+    # model serves perceptual demand, not raw residual energy
+    shares = jnd_masked_shares(analyze_complexity(enc, frames),
+                               aq_strength)
     pass1 = encode_fn(enc)
     pass1_bytes = np.asarray([len(s.payload) for s in pass1], np.float64)
 

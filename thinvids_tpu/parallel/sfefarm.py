@@ -218,7 +218,7 @@ class FarmBandEncoder(SfeShardEncoder):
                         self._edges, mbw=bp.mb_width,
                         mbh_band=bp.band_mb_rows, mesh=mesh,
                         halo_rows=self.halo_rows,
-                        num_bands=bp.num_bands)
+                        num_bands=bp.num_bands, rd=self.rd)
                 outs, carry3 = r[:6], r[8:11]
                 with self.stages.stage("device_wait"):
                     cnt_h, n_h = jax.device_get([r[6], r[7]])
@@ -308,7 +308,8 @@ class FarmBandEncoder(SfeShardEncoder):
                     r = _sfe_intra_step_dense(
                         ys[0], us[0], vs[0], qpj, self._real_rows,
                         mbw=bp.mb_width, mbh_band=bp.band_mb_rows,
-                        mesh=mesh)
+                        mesh=mesh, rd=self.rd,
+                        total_mb_rows=self._total_mb_rows)
                     head, flat, carry3 = None, r[0], r[1:4]
                 else:
                     pred, probe, top_in, bot_in = replay[fi - 1]
@@ -321,7 +322,7 @@ class FarmBandEncoder(SfeShardEncoder):
                         self._edges, mbw=bp.mb_width,
                         mbh_band=bp.band_mb_rows, mesh=mesh,
                         halo_rows=self.halo_rows,
-                        num_bands=bp.num_bands)
+                        num_bands=bp.num_bands, rd=self.rd)
                     head, flat, carry3 = r[0], r[1], r[2:5]
                 if fi < dense_from:
                     continue        # already packed from sparse
